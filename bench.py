@@ -1,0 +1,63 @@
+"""Headline benchmark: batched 5-node Raft partition/crash fuzz throughput.
+
+North star (BASELINE.json): >=100k 5-node cluster-steps/sec/chip with zero safety
+violations. Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.engine import make_fuzz_fn, report
+
+BASELINE_STEPS_PER_SEC = 100_000.0  # BASELINE.json north star
+
+
+def main() -> None:
+    n_clusters = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    n_ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    cfg = SimConfig(
+        n_nodes=5,
+        p_client_cmd=0.2,
+        loss_prob=0.1,
+        p_crash=0.01,
+        p_restart=0.2,
+        max_dead=2,
+        p_repartition=0.02,
+        p_heal=0.05,
+    )
+    fn = make_fuzz_fn(cfg, n_clusters, n_ticks)
+    seed = jnp.asarray(12345, jnp.uint32)
+    jax.block_until_ready(fn(seed))  # compile + warm-up
+    t0 = time.perf_counter()
+    final = jax.block_until_ready(fn(seed))
+    dt = time.perf_counter() - t0
+    rep = report(final)
+    steps_per_sec = n_clusters * n_ticks / dt
+    print(
+        json.dumps(
+            {
+                "metric": "raft_fuzz_cluster_steps_per_sec",
+                "value": round(steps_per_sec, 1),
+                "unit": "cluster-steps/s/chip",
+                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
+                "detail": {
+                    "n_clusters": n_clusters,
+                    "n_ticks": n_ticks,
+                    "wall_s": round(dt, 3),
+                    "violations": int(rep.n_violating),
+                    "clusters_with_commits": int((rep.committed > 0).sum()),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""madtpu — a TPU-native deterministic-simulation framework for fuzzing Raft at scale.
+
+Built from scratch with the capabilities of adaqus/MadRaft (MIT 6.824 Raft labs on the
+MadSim deterministic simulator). Two backends, one spec:
+
+- ``madraft_tpu.tpusim``: the batched lockstep fuzzer — the per-node Raft tick as a
+  pure JAX step function ``vmap``'d over thousands of independent
+  (seed x fault-schedule) clusters, with partitions as boolean adjacency masks and
+  safety invariants as on-device reductions.
+- ``madraft_tpu.simcore``: ctypes bindings to the C++ deterministic event-loop runtime
+  (the oracle and exact replayer; madsim-equivalent, see SURVEY.md §2.6).
+"""
+
+__version__ = "0.1.0"

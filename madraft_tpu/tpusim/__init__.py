@@ -1,0 +1,27 @@
+"""Batched lockstep Raft simulator on TPU.
+
+Re-imagines the reference's discrete-event simulator (madsim 0.1.1, the L0 runtime of
+/root/reference — see SURVEY.md §2.6) as a lockstep pure step function: virtual time is
+quantized into ticks; every per-node behavior (election timers, RequestVote /
+AppendEntries, commit advance) is a masked dense update; the network is a set of
+single-slot per-(dst, src) mailbox tensors with sampled delivery ticks; faults
+(crashes, partitions, message loss) are boolean masks drawn from a counter-based
+per-cluster PRNG. ``jax.vmap`` over the cluster axis fuzzes tens of thousands of
+independent (seed x fault-schedule) clusters per step; safety invariants
+(election safety, log matching, commit durability) run as on-device reductions.
+"""
+
+from madraft_tpu.tpusim.config import SimConfig
+from madraft_tpu.tpusim.state import ClusterState, init_cluster
+from madraft_tpu.tpusim.step import step_cluster
+from madraft_tpu.tpusim.engine import FuzzReport, fuzz, make_fuzz_fn
+
+__all__ = [
+    "SimConfig",
+    "ClusterState",
+    "init_cluster",
+    "step_cluster",
+    "FuzzReport",
+    "fuzz",
+    "make_fuzz_fn",
+]

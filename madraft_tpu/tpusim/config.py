@@ -1,0 +1,69 @@
+"""Static simulation configuration.
+
+Mirrors the knobs of the reference runtime (madsim 0.1.1) and its testers, quantized
+onto a tick grid: the reference draws election timeouts of 150..300ms
+(/root/reference/src/raft/raft.rs:260-263), clerk/RPC latencies of 1-27ms and 10%
+loss in unreliable mode (/root/reference/src/raft/tester.rs:127-137). With the default
+``ms_per_tick=10`` those become 15..30 tick timeouts and 1..3 tick delivery delays.
+
+Everything here is static (hashable) so a ``SimConfig`` can close over jitted step
+functions without retracing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Static parameters of one batched simulation. All times are in ticks."""
+
+    n_nodes: int = 5
+    log_cap: int = 64        # fixed log capacity (circular compaction in snapshot mode)
+    ae_max: int = 4          # max entries carried per AppendEntries message
+
+    # Virtual-time quantization: 1 tick ~ 10 simulated ms.
+    ms_per_tick: int = 10
+    election_timeout_min: int = 15   # 150 ms, raft.rs:262
+    election_timeout_max: int = 30   # 300 ms
+    heartbeat_ticks: int = 5         # 50 ms leader heartbeat cadence
+
+    # Network model (tester.rs:127-137: unreliable = 10% loss, 1-27ms latency).
+    delay_min: int = 1
+    delay_max: int = 3
+    loss_prob: float = 0.0
+
+    # Fault schedule (per-tick Bernoulli draws from the per-cluster PRNG).
+    p_crash: float = 0.0        # alive node crashes (kill: volatile state lost)
+    p_restart: float = 0.2      # dead node restarts (recovers persisted state)
+    p_repartition: float = 0.0  # network re-partitions into a random 2-coloring
+    p_heal: float = 0.0         # network heals to full connectivity
+    max_dead: int = 0           # cap on simultaneously-dead nodes (0 = no crashes)
+
+    # Client workload: probability a leader gets a fresh command injected per tick
+    # (models RaftHandle::start, /root/reference/src/raft/raft.rs:131).
+    p_client_cmd: float = 0.2
+
+    # Deliberate-bug injection for oracle validation (None = correct algorithm).
+    # E.g. majority_override=2 on a 5-node cluster lets two leaders win a term,
+    # which the election-safety oracle must flag.
+    majority_override: int | None = None
+
+    @property
+    def majority(self) -> int:
+        if self.majority_override is not None:
+            return self.majority_override
+        return self.n_nodes // 2 + 1
+
+    def replace(self, **kw) -> "SimConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# Violation bitmask values (oracle reductions; see invariants.py).
+VIOLATION_DUAL_LEADER = 1      # two live leaders share a term (election safety)
+VIOLATION_LOG_MATCHING = 2     # same (index, term) but diverging entries/prefix
+VIOLATION_COMMIT_SHADOW = 4    # a committed entry changed or was lost (durability)
+
+# Role encoding.
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2

@@ -1,0 +1,125 @@
+"""Batch drivers: vmap over clusters, scan over ticks, pjit over chips.
+
+The fuzzer is embarrassingly data-parallel over the cluster axis (SURVEY.md §5:
+"batch parallelism over simulated clusters" is this project's scaling axis) — the
+mesh sharding simply splits clusters across chips; XLA inserts no collectives on the
+hot path, only for the final violation reduction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from madraft_tpu.tpusim.config import SimConfig
+from madraft_tpu.tpusim.state import ClusterState, init_cluster
+from madraft_tpu.tpusim.step import step_cluster
+
+CLUSTER_AXIS = "clusters"
+
+
+class FuzzReport(NamedTuple):
+    """Host-side summary of one fuzz run (per-cluster arrays, length n_clusters)."""
+
+    violations: np.ndarray            # i32 bitmask per cluster (0 = clean)
+    first_violation_tick: np.ndarray  # -1 = none
+    first_leader_tick: np.ndarray     # -1 = never elected (liveness signal)
+    committed: np.ndarray             # entries ever committed (shadow length)
+    msg_count: np.ndarray             # delivered messages
+
+    @property
+    def n_violating(self) -> int:
+        return int((self.violations != 0).sum())
+
+    def violating_clusters(self) -> np.ndarray:
+        return np.nonzero(self.violations != 0)[0]
+
+
+def _cluster_keys(seed, n_clusters: int) -> jax.Array:
+    base = jax.random.PRNGKey(seed)
+    return jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(n_clusters))
+
+
+def make_fuzz_fn(
+    cfg: SimConfig,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+):
+    """Build a jitted fn(seed) -> final batched ClusterState.
+
+    With a mesh, the cluster axis of every state leaf is sharded over the mesh's
+    first axis (pure data parallelism; per-step work stays chip-local).
+    """
+    constraint = None
+    if mesh is not None:
+        axis = mesh.axis_names[0]
+        constraint = NamedSharding(mesh, P(axis))
+
+    def run(seed) -> ClusterState:
+        keys = _cluster_keys(seed, n_clusters)
+        states = jax.vmap(functools.partial(init_cluster, cfg))(keys)
+        if constraint is not None:
+            states = jax.lax.with_sharding_constraint(
+                states, jax.tree.map(lambda _: constraint, states)
+            )
+            keys2 = jax.lax.with_sharding_constraint(keys, constraint)
+        else:
+            keys2 = keys
+
+        def body(carry, _):
+            nxt = jax.vmap(functools.partial(step_cluster, cfg))(carry, keys2)
+            return nxt, None
+
+        final, _ = jax.lax.scan(body, states, None, length=n_ticks)
+        return final
+
+    return jax.jit(run)
+
+
+def report(final: ClusterState) -> FuzzReport:
+    return FuzzReport(
+        violations=np.asarray(final.violations),
+        first_violation_tick=np.asarray(final.first_violation_tick),
+        first_leader_tick=np.asarray(final.first_leader_tick),
+        committed=np.asarray(final.shadow_len),
+        msg_count=np.asarray(final.msg_count),
+    )
+
+
+def fuzz(
+    cfg: SimConfig,
+    seed: int,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+) -> FuzzReport:
+    """Run n_clusters independent (seed x fault-schedule) simulations for n_ticks.
+
+    Every cluster derives its PRNG stream from fold_in(PRNGKey(seed), cluster_id),
+    so any violating cluster is exactly reproducible from (seed, cluster_id) — the
+    MADSIM_TEST_SEED replay contract (/root/reference/README.md:42-55).
+    """
+    fn = make_fuzz_fn(cfg, n_clusters, n_ticks, mesh=mesh)
+    final = jax.block_until_ready(fn(jnp.asarray(seed, jnp.uint32)))
+    return report(final)
+
+
+def replay_cluster(
+    cfg: SimConfig, seed: int, cluster_id: int, n_ticks: int
+) -> ClusterState:
+    """Re-run a single cluster (e.g. a violating one) for inspection/replay."""
+    base = jax.random.PRNGKey(seed)
+    ckey = jax.random.fold_in(base, cluster_id)
+    state = init_cluster(cfg, ckey)
+
+    def body(carry, _):
+        return step_cluster(cfg, carry, ckey), None
+
+    final, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return jax.block_until_ready(final)

@@ -1,0 +1,130 @@
+"""The state-of-the-world of one simulated Raft cluster as a pytree of dense arrays.
+
+One ``ClusterState`` holds every node's Raft state plus the in-flight network as
+single-slot per-(dst, src) mailbox tensors. ``jax.vmap`` over a leading cluster axis
+turns this into the batched fuzzer state (tens of thousands of independent clusters).
+
+Design notes (vs the reference, SURVEY.md §2.6/§7):
+- Persistent state (term, voted_for, log) *is* the array — the lockstep phase order
+  (state updates happen before message emission within a tick) gives the
+  persist-before-send ordering the reference gets from fsync-before-reply
+  (/root/reference/src/raft/raft.rs:224-233). Crash keeps these arrays; restart only
+  resets volatile fields (role, timers, votes, commit, next/match).
+- The network is modeled like madsim's per-message loss/latency draws
+  (/root/reference/src/raft/tester.rs:127-137): each directed (dst, src) pair has one
+  slot per message type with a delivery tick; overwriting an undelivered slot models
+  packet loss (counted faithfully as Raft must tolerate it).
+- Log indices are 1-based as in Raft; array slot k holds index k+1. ``log_len`` and
+  ``commit`` are counts (== highest index present / committed).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from madraft_tpu.tpusim.config import FOLLOWER, SimConfig
+
+I32 = jnp.int32
+BOOL = jnp.bool_
+
+
+class ClusterState(NamedTuple):
+    """All arrays for a single cluster (vmap adds the cluster axis)."""
+
+    tick: jax.Array            # i32 scalar: current tick
+    # --- per-node Raft state [N] ---
+    term: jax.Array            # i32 current term (persistent)
+    voted_for: jax.Array       # i32, -1 = none (persistent)
+    role: jax.Array            # i32: 0 follower / 1 candidate / 2 leader
+    timer: jax.Array           # i32 ticks until election timeout
+    hb: jax.Array              # i32 ticks until next leader heartbeat
+    alive: jax.Array           # bool
+    # --- log [N, CAP] (persistent) ---
+    log_term: jax.Array        # i32
+    log_val: jax.Array         # i32 (commands are unique ints)
+    log_len: jax.Array         # i32 [N] entry count
+    commit: jax.Array          # i32 [N] committed count (volatile)
+    # --- candidate / leader bookkeeping ---
+    votes: jax.Array           # bool [N, N]: votes[i, j] = candidate i holds j's grant
+    next_idx: jax.Array        # i32 [N, N]: leader i's next index for peer j (1-based)
+    match_idx: jax.Array       # i32 [N, N]: leader i's known match count for peer j
+    # --- network ---
+    adj: jax.Array             # bool [N, N] directed link usable (diag True)
+    # RequestVote request mailbox [dst, src]
+    rv_req_t: jax.Array        # i32 delivery tick; 0 = empty
+    rv_req_term: jax.Array
+    rv_req_lli: jax.Array      # candidate last log index (count)
+    rv_req_llt: jax.Array      # candidate last log term
+    # RequestVote response mailbox [dst(candidate), src(voter)]
+    rv_rsp_t: jax.Array
+    rv_rsp_term: jax.Array
+    rv_rsp_granted: jax.Array  # bool
+    # AppendEntries request mailbox [dst, src]
+    ae_req_t: jax.Array
+    ae_req_term: jax.Array
+    ae_req_prev: jax.Array     # prev log index (count before batch)
+    ae_req_prev_term: jax.Array
+    ae_req_n: jax.Array        # entries carried (<= ae_max)
+    ae_req_commit: jax.Array   # leader commit
+    ae_req_ent_term: jax.Array  # i32 [N, N, AE_MAX]
+    ae_req_ent_val: jax.Array   # i32 [N, N, AE_MAX]
+    # AppendEntries response mailbox [dst(leader), src(follower)]
+    ae_rsp_t: jax.Array
+    ae_rsp_term: jax.Array
+    ae_rsp_success: jax.Array  # bool
+    ae_rsp_match: jax.Array    # success: new match count; failure: next-index hint - 1
+    # --- workload / oracle ---
+    next_cmd: jax.Array        # i32 scalar: per-cluster unique command counter
+    shadow_term: jax.Array     # i32 [CAP] committed-entry shadow (durability oracle)
+    shadow_val: jax.Array      # i32 [CAP]
+    shadow_len: jax.Array      # i32 scalar
+    violations: jax.Array      # i32 scalar sticky bitmask
+    first_violation_tick: jax.Array  # i32 scalar, -1 = none
+    first_leader_tick: jax.Array     # i32 scalar, -1 = none (liveness metric)
+    msg_count: jax.Array       # i32 scalar: delivered messages (tester.rs:147-149)
+
+
+def init_cluster(cfg: SimConfig, key: jax.Array) -> ClusterState:
+    """Fresh cluster at tick 0 with randomized election timers (raft.rs:260-263)."""
+    n, cap, ae = cfg.n_nodes, cfg.log_cap, cfg.ae_max
+    zn = jnp.zeros((n,), I32)
+    znn = jnp.zeros((n, n), I32)
+    timer = jax.random.randint(
+        key, (n,), cfg.election_timeout_min, cfg.election_timeout_max + 1, dtype=I32
+    )
+    return ClusterState(
+        tick=jnp.asarray(0, I32),
+        term=zn,
+        voted_for=jnp.full((n,), -1, I32),
+        role=jnp.full((n,), FOLLOWER, I32),
+        timer=timer,
+        hb=zn,
+        alive=jnp.ones((n,), BOOL),
+        log_term=jnp.zeros((n, cap), I32),
+        log_val=jnp.zeros((n, cap), I32),
+        log_len=zn,
+        commit=zn,
+        votes=jnp.zeros((n, n), BOOL),
+        next_idx=jnp.ones((n, n), I32),
+        match_idx=znn,
+        adj=jnp.ones((n, n), BOOL),
+        rv_req_t=znn, rv_req_term=znn, rv_req_lli=znn, rv_req_llt=znn,
+        rv_rsp_t=znn, rv_rsp_term=znn, rv_rsp_granted=jnp.zeros((n, n), BOOL),
+        ae_req_t=znn, ae_req_term=znn, ae_req_prev=znn, ae_req_prev_term=znn,
+        ae_req_n=znn, ae_req_commit=znn,
+        ae_req_ent_term=jnp.zeros((n, n, ae), I32),
+        ae_req_ent_val=jnp.zeros((n, n, ae), I32),
+        ae_rsp_t=znn, ae_rsp_term=znn,
+        ae_rsp_success=jnp.zeros((n, n), BOOL), ae_rsp_match=znn,
+        next_cmd=jnp.asarray(0, I32),
+        shadow_term=jnp.zeros((cap,), I32),
+        shadow_val=jnp.zeros((cap,), I32),
+        shadow_len=jnp.asarray(0, I32),
+        violations=jnp.asarray(0, I32),
+        first_violation_tick=jnp.asarray(-1, I32),
+        first_leader_tick=jnp.asarray(-1, I32),
+        msg_count=jnp.asarray(0, I32),
+    )

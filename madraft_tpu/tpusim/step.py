@@ -1,0 +1,376 @@
+"""One lockstep tick of a simulated Raft cluster, as a pure JAX function.
+
+This is the batched re-imagination of the reference's per-node async tick
+(/root/reference/src/raft/raft.rs: election timer 260-263, RequestVote fan-out
+266-293, RPC handlers 213-233) plus the simulator semantics it runs on
+(SURVEY.md §2.6): per-message loss/latency draws, pairwise partitions, kill/restart
+with persistent state, message counting.
+
+Phase order within a tick (this ordering gives persist-before-send for free — all
+sends are computed from post-update persistent arrays, mirroring the reference's
+"persist after RPC handlers mutate state" rule at raft.rs:224-233):
+
+  1. faults     — crash / restart / repartition draws
+  2. deliver    — process every mailbox slot due this tick (sequential over sources
+                  for per-node sequential semantics; vectorized over destinations)
+  3. timers     — election timeouts -> candidacy + RequestVote broadcast;
+                  client command injection at leaders; leader heartbeat ->
+                  AppendEntries broadcast with entries from next_idx
+  4. commit     — leader advances commit via majority-match (current-term rule)
+  5. oracle     — safety invariant reductions (election safety, log matching,
+                  commit durability) + liveness/stat bookkeeping
+
+Control flow divergence across the batch is handled with masked updates
+(`jnp.where`) throughout; loops are only over the (static, tiny) node and
+entry-batch axes, so XLA sees fully static shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from madraft_tpu.tpusim.config import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    SimConfig,
+    VIOLATION_COMMIT_SHADOW,
+    VIOLATION_DUAL_LEADER,
+    VIOLATION_LOG_MATCHING,
+)
+from madraft_tpu.tpusim.state import ClusterState, I32
+
+# PRNG site ids (fold_in constants) — one stream per independent decision site.
+_S_FAULT, _S_RVREQ, _S_AEREQ, _S_TIMER, _S_CLIENT, _S_HB, _S_GRANT, _S_AERESET = (
+    0, 1, 2, 3, 4, 5, 6, 7,
+)
+
+
+def _timeout_draw(cfg: SimConfig, key: jax.Array, shape) -> jax.Array:
+    return jax.random.randint(
+        key, shape, cfg.election_timeout_min, cfg.election_timeout_max + 1, dtype=I32
+    )
+
+
+def _net_draws(cfg: SimConfig, key: jax.Array, shape):
+    """(delay, lost) draws for a batch of sends."""
+    kd, kl = jax.random.split(key)
+    delay = jax.random.randint(kd, shape, cfg.delay_min, cfg.delay_max + 1, dtype=I32)
+    lost = jax.random.bernoulli(kl, cfg.loss_prob, shape)
+    return delay, lost
+
+
+def _row_term(log_term: jax.Array, pos: jax.Array, cap: int) -> jax.Array:
+    """log_term[i, pos[i]] with clipped gather; callers mask invalid positions."""
+    n = log_term.shape[0]
+    return log_term[jnp.arange(n), jnp.clip(pos, 0, cap - 1)]
+
+
+def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> ClusterState:
+    n, cap, ae_max = cfg.n_nodes, cfg.log_cap, cfg.ae_max
+    t = s.tick + 1  # messages sent at tick t-1 with delay 1 arrive now
+    key = jax.random.fold_in(cluster_key, t)
+    me = jnp.arange(n, dtype=I32)
+    eye = jnp.eye(n, dtype=jnp.bool_)
+
+    # ------------------------------------------------------------------ faults
+    kf = jax.random.split(jax.random.fold_in(key, _S_FAULT), 5)
+    restart = (~s.alive) & jax.random.bernoulli(kf[0], cfg.p_restart, (n,))
+    crash_draw = s.alive & jax.random.bernoulli(kf[1], cfg.p_crash, (n,))
+    # Keep a quorum-capable cluster: at most max_dead simultaneously-dead nodes.
+    dead_after_restart = jnp.sum((~s.alive) & (~restart))
+    budget = jnp.asarray(cfg.max_dead, I32) - dead_after_restart
+    crash = crash_draw & (jnp.cumsum(crash_draw.astype(I32)) <= budget)
+    alive = (s.alive | restart) & ~crash
+
+    # Restart = recovery from persisted state (term/voted_for/log survive; the
+    # volatile set resets — raft.rs:194-211 restore(), tester.rs:284-327).
+    role = jnp.where(restart, FOLLOWER, s.role)
+    timer = jnp.where(restart, _timeout_draw(cfg, kf[2], (n,)), s.timer)
+    hb = jnp.where(restart, 0, s.hb)
+    commit = jnp.where(restart, 0, s.commit)
+    votes = jnp.where(restart[:, None], False, s.votes)
+    next_idx = jnp.where(restart[:, None], 1, s.next_idx)
+    match_idx = jnp.where(restart[:, None], 0, s.match_idx)
+
+    # Partition schedule: random 2-coloring / heal (connect2/disconnect2 masks,
+    # /root/reference/src/kvraft/tester.rs:88-124).
+    u_part = jax.random.uniform(kf[3], ())
+    colors = jax.random.bernoulli(kf[4], 0.5, (n,))
+    part_adj = colors[:, None] == colors[None, :]
+    do_part = u_part < cfg.p_repartition
+    do_heal = (~do_part) & (u_part < cfg.p_repartition + cfg.p_heal)
+    adj = jnp.where(do_part, part_adj, jnp.where(do_heal, True, s.adj)) | eye
+
+    term, voted_for = s.term, s.voted_for
+    log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
+    rv_rsp_t, rv_rsp_term, rv_rsp_granted = s.rv_rsp_t, s.rv_rsp_term, s.rv_rsp_granted
+    ae_rsp_t, ae_rsp_term = s.ae_rsp_t, s.ae_rsp_term
+    ae_rsp_success, ae_rsp_match = s.ae_rsp_success, s.ae_rsp_match
+    delivered = jnp.asarray(0, I32)
+
+    # ----------------------------------------------------- deliver: RV requests
+    k_grant = jax.random.fold_in(key, _S_GRANT)
+    for src in range(n):
+        arr = (s.rv_req_t[:, src] == t) & alive
+        delivered += jnp.sum(arr, dtype=I32)
+        mterm = s.rv_req_term[:, src]
+        higher = arr & (mterm > term)
+        term = jnp.where(higher, mterm, term)
+        role = jnp.where(higher, FOLLOWER, role)
+        voted_for = jnp.where(higher, -1, voted_for)
+        my_llt = jnp.where(log_len > 0, _row_term(log_term, log_len - 1, cap), 0)
+        log_ok = (s.rv_req_llt[:, src] > my_llt) | (
+            (s.rv_req_llt[:, src] == my_llt) & (s.rv_req_lli[:, src] >= log_len)
+        )
+        grant = arr & (mterm == term) & ((voted_for == -1) | (voted_for == src)) & log_ok
+        voted_for = jnp.where(grant, src, voted_for)
+        ks = jax.random.fold_in(k_grant, src)
+        timer = jnp.where(grant, _timeout_draw(cfg, ks, (n,)), timer)
+        delay, lost = _net_draws(cfg, jax.random.fold_in(jax.random.fold_in(key, _S_RVREQ), src), (n,))
+        send = arr & adj[:, src] & ~lost
+        rv_rsp_t = rv_rsp_t.at[src, :].set(jnp.where(send, t + delay, rv_rsp_t[src, :]))
+        rv_rsp_term = rv_rsp_term.at[src, :].set(jnp.where(send, term, rv_rsp_term[src, :]))
+        rv_rsp_granted = rv_rsp_granted.at[src, :].set(
+            jnp.where(send, grant, rv_rsp_granted[src, :])
+        )
+    rv_req_t = jnp.where(s.rv_req_t == t, 0, s.rv_req_t)
+
+    # ----------------------------------------------------- deliver: AE requests
+    k_aereset = jax.random.fold_in(key, _S_AERESET)
+    for src in range(n):
+        arr = (s.ae_req_t[:, src] == t) & alive
+        delivered += jnp.sum(arr, dtype=I32)
+        mterm = s.ae_req_term[:, src]
+        higher = arr & (mterm > term)
+        term = jnp.where(higher, mterm, term)
+        role = jnp.where(higher, FOLLOWER, role)
+        voted_for = jnp.where(higher, -1, voted_for)
+        acc = arr & (mterm == term)  # AppendEntries from the current-term leader
+        role = jnp.where(acc & (role == CANDIDATE), FOLLOWER, role)
+        timer = jnp.where(
+            acc, _timeout_draw(cfg, jax.random.fold_in(k_aereset, src), (n,)), timer
+        )
+        prev = s.ae_req_prev[:, src]
+        prev_ok = (prev == 0) | (
+            (prev <= log_len) & (_row_term(log_term, prev - 1, cap) == s.ae_req_prev_term[:, src])
+        )
+        success = acc & prev_ok
+        nent = s.ae_req_n[:, src]
+        conflict_any = jnp.zeros((n,), jnp.bool_)
+        for e in range(ae_max):
+            idx = prev + e  # 0-based slot of this batch entry
+            in_batch = success & (e < nent) & (idx < cap)
+            ent_t = s.ae_req_ent_term[:, src, e]
+            ent_v = s.ae_req_ent_val[:, src, e]
+            conflict_any |= in_batch & (idx < log_len) & (_row_term(log_term, idx, cap) != ent_t)
+            slot = jnp.clip(idx, 0, cap - 1)
+            log_term = log_term.at[me, slot].set(
+                jnp.where(in_batch, ent_t, log_term[me, slot])
+            )
+            log_val = log_val.at[me, slot].set(
+                jnp.where(in_batch, ent_v, log_val[me, slot])
+            )
+        batch_end = jnp.clip(prev + nent, 0, cap)
+        # Conflict => truncate to the rewritten batch; otherwise never shrink
+        # (a heartbeat must not drop entries a newer AE already appended).
+        log_len = jnp.where(
+            success,
+            jnp.where(conflict_any, batch_end, jnp.maximum(log_len, batch_end)),
+            log_len,
+        )
+        commit = jnp.where(
+            success,
+            jnp.maximum(commit, jnp.minimum(s.ae_req_commit[:, src], prev + nent)),
+            commit,
+        )
+        # Failure hint for fast backtracking (term-skip): first index of the
+        # conflicting term, or our log length if the leader's prev is past our end.
+        over = prev > log_len
+        conf_term = _row_term(log_term, prev - 1, cap)
+        first_of_term = jnp.argmax(log_term == conf_term[:, None], axis=1).astype(I32)
+        hint = jnp.where(over, log_len, first_of_term)
+        rsp_match = jnp.where(success, prev + nent, hint)
+        delay, lost = _net_draws(cfg, jax.random.fold_in(jax.random.fold_in(key, _S_AEREQ), src), (n,))
+        send = arr & adj[:, src] & ~lost
+        ae_rsp_t = ae_rsp_t.at[src, :].set(jnp.where(send, t + delay, ae_rsp_t[src, :]))
+        ae_rsp_term = ae_rsp_term.at[src, :].set(jnp.where(send, term, ae_rsp_term[src, :]))
+        ae_rsp_success = ae_rsp_success.at[src, :].set(
+            jnp.where(send, success, ae_rsp_success[src, :])
+        )
+        ae_rsp_match = ae_rsp_match.at[src, :].set(
+            jnp.where(send, rsp_match, ae_rsp_match[src, :])
+        )
+    ae_req_t = jnp.where(s.ae_req_t == t, 0, s.ae_req_t)
+
+    # ---------------------------------------------------- deliver: RV responses
+    for src in range(n):
+        arr = (rv_rsp_t[:, src] == t) & alive
+        delivered += jnp.sum(arr, dtype=I32)
+        mterm = rv_rsp_term[:, src]
+        higher = arr & (mterm > term)
+        term = jnp.where(higher, mterm, term)
+        role = jnp.where(higher, FOLLOWER, role)
+        voted_for = jnp.where(higher, -1, voted_for)
+        got = arr & rv_rsp_granted[:, src] & (role == CANDIDATE) & (mterm == term)
+        votes = votes.at[:, src].set(votes[:, src] | got)
+    rv_rsp_t = jnp.where(rv_rsp_t <= t, 0, rv_rsp_t)
+
+    # ---------------------------------------------------- deliver: AE responses
+    for src in range(n):
+        arr = (ae_rsp_t[:, src] == t) & alive
+        delivered += jnp.sum(arr, dtype=I32)
+        mterm = ae_rsp_term[:, src]
+        higher = arr & (mterm > term)
+        term = jnp.where(higher, mterm, term)
+        role = jnp.where(higher, FOLLOWER, role)
+        voted_for = jnp.where(higher, -1, voted_for)
+        ok = arr & (role == LEADER) & (mterm == term)
+        succ = ok & ae_rsp_success[:, src]
+        fail = ok & ~ae_rsp_success[:, src]
+        m = ae_rsp_match[:, src]
+        match_idx = match_idx.at[:, src].set(
+            jnp.where(succ, jnp.maximum(match_idx[:, src], m), match_idx[:, src])
+        )
+        nxt = jnp.where(
+            succ,
+            jnp.maximum(next_idx[:, src], m + 1),
+            jnp.where(fail, jnp.maximum(jnp.minimum(next_idx[:, src], m + 1), 1), next_idx[:, src]),
+        )
+        next_idx = next_idx.at[:, src].set(nxt)
+    ae_rsp_t = jnp.where(ae_rsp_t <= t, 0, ae_rsp_t)
+
+    # Candidate -> leader on majority (election win; raft.rs:286-292 drain path).
+    win = alive & (role == CANDIDATE) & (jnp.sum(votes, axis=1) >= cfg.majority)
+    role = jnp.where(win, LEADER, role)
+    next_idx = jnp.where(win[:, None], log_len[:, None] + 1, next_idx)
+    match_idx = jnp.where(win[:, None], 0, match_idx)
+    hb = jnp.where(win, 0, hb)  # announce leadership with an immediate heartbeat
+
+    # ------------------------------------------------- timers: election timeout
+    kt = jax.random.split(jax.random.fold_in(key, _S_TIMER), 3)
+    running = alive & (role != LEADER)
+    timer = jnp.where(running, timer - 1, timer)
+    fired = running & (timer <= 0)
+    term = jnp.where(fired, term + 1, term)
+    role = jnp.where(fired, CANDIDATE, role)
+    voted_for = jnp.where(fired, me, voted_for)
+    votes = jnp.where(fired[:, None], eye, votes)
+    timer = jnp.where(fired, _timeout_draw(cfg, kt[0], (n,)), timer)
+
+    llt = jnp.where(log_len > 0, _row_term(log_term, log_len - 1, cap), 0)
+    delay, lost = _net_draws(cfg, kt[1], (n, n))
+    send_rv = fired[None, :] & ~eye & adj.T & ~lost  # [dst, src], link src->dst
+    rv_req_t = jnp.where(send_rv, t + delay, rv_req_t)
+    rv_req_term = jnp.where(send_rv, term[None, :], s.rv_req_term)
+    rv_req_lli = jnp.where(send_rv, log_len[None, :], s.rv_req_lli)
+    rv_req_llt = jnp.where(send_rv, llt[None, :], s.rv_req_llt)
+
+    # --------------------------------------- client command injection at leaders
+    lead = alive & (role == LEADER)
+    inject = (
+        lead
+        & jax.random.bernoulli(jax.random.fold_in(key, _S_CLIENT), cfg.p_client_cmd, (n,))
+        & (log_len < cap)
+    )
+    slot = jnp.clip(log_len, 0, cap - 1)
+    cmd_val = s.next_cmd * n + me + 1  # unique within the cluster, never 0
+    log_term = log_term.at[me, slot].set(jnp.where(inject, term, log_term[me, slot]))
+    log_val = log_val.at[me, slot].set(jnp.where(inject, cmd_val, log_val[me, slot]))
+    log_len = jnp.where(inject, log_len + 1, log_len)
+    next_cmd = s.next_cmd + jnp.any(inject).astype(I32)
+
+    # -------------------------------------------- leader heartbeat / replication
+    hb = jnp.where(lead, hb - 1, hb)
+    fire_hb = lead & (hb <= 0)
+    hb = jnp.where(fire_hb, cfg.heartbeat_ticks, hb)
+    prev_m = next_idx.T - 1  # [dst, src]: src's prev index for dst
+    n_m = jnp.clip(log_len[None, :] - prev_m, 0, ae_max)
+    idxs = prev_m[:, :, None] + jnp.arange(ae_max, dtype=I32)[None, None, :]
+    log_t_b = jnp.broadcast_to(log_term[None, :, :], (n, n, cap))
+    log_v_b = jnp.broadcast_to(log_val[None, :, :], (n, n, cap))
+    ent_t = jnp.take_along_axis(log_t_b, jnp.clip(idxs, 0, cap - 1), axis=2)
+    ent_v = jnp.take_along_axis(log_v_b, jnp.clip(idxs, 0, cap - 1), axis=2)
+    prev_term_m = jnp.where(
+        prev_m > 0,
+        jnp.take_along_axis(log_t_b, jnp.clip(prev_m - 1, 0, cap - 1)[:, :, None], axis=2)[:, :, 0],
+        0,
+    )
+    delay, lost = _net_draws(cfg, jax.random.fold_in(key, _S_HB), (n, n))
+    send_ae = fire_hb[None, :] & ~eye & adj.T & ~lost
+    ae_req_t = jnp.where(send_ae, t + delay, ae_req_t)
+    ae_req_term = jnp.where(send_ae, term[None, :], s.ae_req_term)
+    ae_req_prev = jnp.where(send_ae, prev_m, s.ae_req_prev)
+    ae_req_prev_term = jnp.where(send_ae, prev_term_m, s.ae_req_prev_term)
+    ae_req_n = jnp.where(send_ae, n_m, s.ae_req_n)
+    ae_req_commit = jnp.where(send_ae, commit[None, :], s.ae_req_commit)
+    ae_req_ent_term = jnp.where(send_ae[:, :, None], ent_t, s.ae_req_ent_term)
+    ae_req_ent_val = jnp.where(send_ae[:, :, None], ent_v, s.ae_req_ent_val)
+
+    # ------------------------------------------------------------ commit advance
+    mi = match_idx.at[me, me].set(log_len)
+    kth = -jnp.sort(-mi, axis=1)[:, cfg.majority - 1]  # majority-th largest match
+    cur_term_ok = (kth > 0) & (_row_term(log_term, kth - 1, cap) == term)
+    commit = jnp.where(lead & cur_term_ok, jnp.maximum(commit, kth), commit)
+
+    # ------------------------------------------------------------------- oracle
+    viol = jnp.asarray(0, I32)
+    # Election safety: two live leaders sharing a term (tester.rs:81-83).
+    is_lead = alive & (role == LEADER)
+    dual = (
+        is_lead[:, None] & is_lead[None, :] & ~eye & (term[:, None] == term[None, :])
+    )
+    viol |= jnp.where(jnp.any(dual), VIOLATION_DUAL_LEADER, 0)
+    # Log matching: same (index, term) => identical prefix (includes crashed nodes'
+    # persisted logs — the property holds for all logs at all times).
+    ks_ = jnp.arange(cap)
+    both = ks_[None, None, :] < jnp.minimum(log_len[:, None], log_len[None, :])[:, :, None]
+    tmatch = both & (log_term[:, None, :] == log_term[None, :, :])
+    eq = tmatch & (log_val[:, None, :] == log_val[None, :, :])
+    pref = jnp.cumprod((eq | ~both).astype(I32), axis=2).astype(jnp.bool_)
+    viol |= jnp.where(jnp.any(tmatch & ~pref), VIOLATION_LOG_MATCHING, 0)
+    # Commit durability: every entry any node ever committed is recorded in a
+    # shadow log; later commits must agree (catches Figure-8-style commit loss;
+    # the online analogue of StorageHandle.push_and_check, tester.rs:379-397).
+    shadow_term, shadow_val, shadow_len = s.shadow_term, s.shadow_val, s.shadow_len
+    for i in range(n):
+        c = commit[i]
+        known = ks_ < jnp.minimum(c, shadow_len)
+        differ = known & (
+            (shadow_term != log_term[i]) | (shadow_val != log_val[i])
+        )
+        viol |= jnp.where(jnp.any(differ), VIOLATION_COMMIT_SHADOW, 0)
+        new = (ks_ >= shadow_len) & (ks_ < c)
+        shadow_term = jnp.where(new, log_term[i], shadow_term)
+        shadow_val = jnp.where(new, log_val[i], shadow_val)
+        shadow_len = jnp.maximum(shadow_len, c)
+
+    violations = s.violations | viol
+    first_violation_tick = jnp.where(
+        (s.first_violation_tick < 0) & (viol != 0), t, s.first_violation_tick
+    )
+    first_leader_tick = jnp.where(
+        (s.first_leader_tick < 0) & jnp.any(is_lead), t, s.first_leader_tick
+    )
+
+    return ClusterState(
+        tick=t,
+        term=term, voted_for=voted_for, role=role, timer=timer, hb=hb, alive=alive,
+        log_term=log_term, log_val=log_val, log_len=log_len, commit=commit,
+        votes=votes, next_idx=next_idx, match_idx=match_idx, adj=adj,
+        rv_req_t=rv_req_t, rv_req_term=rv_req_term,
+        rv_req_lli=rv_req_lli, rv_req_llt=rv_req_llt,
+        rv_rsp_t=rv_rsp_t, rv_rsp_term=rv_rsp_term, rv_rsp_granted=rv_rsp_granted,
+        ae_req_t=ae_req_t, ae_req_term=ae_req_term, ae_req_prev=ae_req_prev,
+        ae_req_prev_term=ae_req_prev_term, ae_req_n=ae_req_n,
+        ae_req_commit=ae_req_commit,
+        ae_req_ent_term=ae_req_ent_term, ae_req_ent_val=ae_req_ent_val,
+        ae_rsp_t=ae_rsp_t, ae_rsp_term=ae_rsp_term,
+        ae_rsp_success=ae_rsp_success, ae_rsp_match=ae_rsp_match,
+        next_cmd=next_cmd,
+        shadow_term=shadow_term, shadow_val=shadow_val, shadow_len=shadow_len,
+        violations=violations, first_violation_tick=first_violation_tick,
+        first_leader_tick=first_leader_tick,
+        msg_count=s.msg_count + delivered,
+    )

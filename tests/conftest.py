@@ -1,0 +1,13 @@
+"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+
+Must run before the first `import jax` in the process (pytest imports conftest
+first). Bench (`bench.py`) and the graft entry are unaffected — they run outside
+pytest and see the real TPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
