@@ -1,0 +1,135 @@
+#include "simcore.h"
+
+namespace simcore {
+
+static thread_local Sim* g_current = nullptr;
+
+Sim::Sim(uint64_t seed) : seed_(seed), rng_(seed) { g_current = this; }
+Sim::~Sim() {
+  // destroy any still-live frames (tests that end with tasks running)
+  for (auto& [tid, h] : frames_) {
+    if (live_.count(tid)) h.destroy();
+  }
+  if (g_current == this) g_current = nullptr;
+}
+
+Sim* Sim::current() { return g_current; }
+
+void Sim::schedule(uint64_t at, std::function<void()> fn) {
+  events_.push(Event{at < now_ ? now_ : at, seq_++, std::move(fn)});
+}
+
+void Sim::resume_in_context(uint64_t tid, std::coroutine_handle<> h) {
+  Addr prev_addr = cur_addr_;
+  uint64_t prev_task = cur_task_;
+  cur_addr_ = task_addr_[tid];
+  cur_task_ = tid;
+  h.resume();
+  cur_addr_ = prev_addr;
+  cur_task_ = prev_task;
+}
+
+void Sim::task_finished(uint64_t tid) {
+  live_.erase(tid);
+  finished_.push_back(tid);
+}
+
+std::function<void()> Sim::guarded_resume_here(std::coroutine_handle<> h) {
+  uint64_t tid = cur_task_;
+  return [this, tid, h] {
+    if (task_live(tid)) resume_in_context(tid, h);
+  };
+}
+
+void Sim::abort_task(uint64_t tid) {
+  if (!live_.count(tid)) return;
+  live_.erase(tid);
+  auto it = frames_.find(tid);
+  if (it != frames_.end()) {
+    it->second.destroy();
+    frames_.erase(it);
+  }
+  task_addr_.erase(tid);
+}
+
+void Sim::kill(Addr node) {
+  // crash semantics (reference Handle::kill, tester.rs:329-333): all the
+  // node's tasks die, its RPC handlers vanish (in-flight requests to it get
+  // dropped -> caller timeout), its files survive for restart/restore.
+  auto it = node_tasks_.find(node);
+  if (it != node_tasks_.end()) {
+    for (uint64_t tid : it->second) {
+      if (!live_.count(tid)) continue;
+      live_.erase(tid);
+      auto fit = frames_.find(tid);
+      if (fit != frames_.end()) {
+        fit->second.destroy();
+        frames_.erase(fit);
+      }
+      task_addr_.erase(tid);
+    }
+    it->second.clear();
+  }
+  handlers_.erase(node);
+}
+
+uint64_t Sim::draw_delivery() {
+  // per-message decisions, like the reference's loss/latency model
+  // (tester.rs:127-137); draw order fixed for determinism
+  double loss = netcfg_.packet_loss_rate;
+  uint64_t lat = netcfg_.send_latency_min == netcfg_.send_latency_max
+                     ? netcfg_.send_latency_min
+                     : rand_range(netcfg_.send_latency_min,
+                                  netcfg_.send_latency_max + 1);
+  if (loss > 0.0 && rand_bool(loss)) return 0;
+  return lat == 0 ? 1 : lat;
+}
+
+void Sim::send_reply(Addr from, Addr to, uint64_t rpc_id, std::any reply) {
+  if (!link_up(from, to)) return;
+  uint64_t dt = draw_delivery();
+  if (dt == 0) return;  // reply lost; caller times out
+  schedule(now_ + dt, [this, from, to, rpc_id, reply = std::move(reply)]() mutable {
+    if (!link_up(from, to)) return;
+    auto it = pending_.find(rpc_id);
+    if (it == pending_.end()) return;  // caller gave up (timeout fired)
+    auto p = it->second;
+    pending_.erase(it);
+    msg_count_++;
+    if (!p->settled) {
+      p->settled = true;
+      p->finish(std::move(reply));
+    }
+  });
+}
+
+void Sim::SleepAwaiter::await_suspend(std::coroutine_handle<> h) {
+  sim->schedule(sim->now() + dur, sim->guarded_resume_here(h));
+}
+
+bool Sim::run(Task<void> main) {
+  g_current = this;
+  auto ref = spawn(Addr(0), std::move(main));
+  while (!ref.done()) {
+    if (events_.empty()) return false;  // deadlock
+    Event ev = std::move(const_cast<Event&>(events_.top()));
+    events_.pop();
+    now_ = ev.t;
+    // fold the pop into the determinism trace (FNV-1a style)
+    trace_hash_ ^= ev.t + 0x9e3779b97f4a7c15ull + (trace_hash_ << 6);
+    trace_hash_ *= 0x100000001b3ull;
+    ev.fn();
+    for (uint64_t tid : finished_) {
+      auto it = frames_.find(tid);
+      if (it != frames_.end()) {
+        it->second.destroy();
+        frames_.erase(it);
+      }
+      task_addr_.erase(tid);
+    }
+    finished_.clear();
+  }
+  return true;
+}
+
+}  // namespace simcore

@@ -1,0 +1,514 @@
+// simcore — deterministic discrete-event simulation runtime for distributed
+// systems tests, built from scratch as the madsim-equivalent L0 of this
+// framework (contract: SURVEY.md §2.6; the reference call sites are the
+// madsim 0.1.1 API used by /root/reference — Runtime/Handle/LocalHandle,
+// net, fs, time, task, rand).
+//
+// Design (deliberately NOT a port; madsim is Rust+tokio-style):
+//   * single-threaded; virtual time advances only via the event queue
+//   * events ordered by (virtual_time, seq) — seq is a monotonic counter, so
+//     ties break FIFO and runs are bit-reproducible from the seed (no
+//     address-based ordering anywhere, ASLR-proof)
+//   * node code = C++20 coroutines; ONLY leaf awaitables (sleep, rpc call,
+//     channel recv, task join) — no arbitrary nesting, which keeps kill()
+//     (crash a node: destroy its coroutine frames, keep its filesystem)
+//     safe: every pending continuation is guarded by a live-task check
+//     before resume, so a killed task's dangling frame is never touched
+//   * RPC payloads move as typed in-process values (std::any) —
+//     serialization is semantically irrelevant in-process; the persistence
+//     path (fs) uses real byte encoding, matching the reference's
+//     "state"/"snapshot" file contract
+//   * fault injection is first-class: per-message loss + latency draws from
+//     the seeded RNG, whole-node connect/disconnect (both directions),
+//     pairwise connect2/disconnect2, kill/respawn
+//   * determinism check: a rolling trace hash folded at every event pop;
+//     two runs with the same seed must produce identical hashes (the
+//     MADSIM_TEST_CHECK_DETERMINISTIC analogue, reference README.md:81-87).
+#pragma once
+
+#include <any>
+#include <cassert>
+#include <coroutine>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <optional>
+#include <queue>
+#include <random>
+#include <set>
+#include <string>
+#include <typeindex>
+#include <unordered_map>
+#include <unordered_set>
+#include <utility>
+#include <vector>
+
+namespace simcore {
+
+using Addr = uint32_t;  // IPv4-style encoded address (port irrelevant in-sim)
+using Bytes = std::vector<uint8_t>;
+
+constexpr Addr make_addr(unsigned a, unsigned b, unsigned c, unsigned d) {
+  return (Addr(a) << 24) | (Addr(b) << 16) | (Addr(c) << 8) | Addr(d);
+}
+inline std::string addr_str(Addr a) {
+  char buf[24];
+  std::snprintf(buf, sizeof buf, "%u.%u.%u.%u", (a >> 24) & 255, (a >> 16) & 255,
+                (a >> 8) & 255, a & 255);
+  return buf;
+}
+
+constexpr uint64_t USEC = 1000;
+constexpr uint64_t MSEC = 1000 * USEC;
+constexpr uint64_t SEC = 1000 * MSEC;
+
+class Sim;
+
+// ------------------------------------------------------------------ Task<T>
+// Lazy coroutine. Spawn on a node via Sim::spawn; the returned TaskRef can be
+// co_awaited (join), aborted, or dropped (the task keeps running — detach is
+// the default, like the reference's spawn(..).detach()).
+template <class T>
+struct JoinState {
+  bool done = false;
+  bool aborted = false;
+  std::optional<T> value;
+  std::vector<std::function<void()>> waiters;  // scheduled on completion
+};
+template <>
+struct JoinState<void> {
+  bool done = false;
+  bool aborted = false;
+  std::vector<std::function<void()>> waiters;
+};
+
+namespace detail {
+template <class T>
+struct PromiseBase {
+  std::shared_ptr<JoinState<T>> js = std::make_shared<JoinState<T>>();
+  Sim* sim = nullptr;
+  uint64_t task_id = 0;
+  std::suspend_always initial_suspend() noexcept { return {}; }
+  struct FinalAwaiter {
+    bool await_ready() noexcept { return false; }
+    template <class P>
+    void await_suspend(std::coroutine_handle<P> h) noexcept;
+    void await_resume() noexcept {}
+  };
+  FinalAwaiter final_suspend() noexcept { return {}; }
+  void unhandled_exception() noexcept {
+    std::fprintf(stderr, "simcore: unhandled exception in task\n");
+    std::abort();
+  }
+};
+}  // namespace detail
+
+template <class T>
+class Task {
+ public:
+  struct promise_type : detail::PromiseBase<T> {
+    Task get_return_object() {
+      return Task(std::coroutine_handle<promise_type>::from_promise(*this));
+    }
+    void return_value(T v) { this->js->value = std::move(v); }
+  };
+  explicit Task(std::coroutine_handle<promise_type> h) : h_(h) {}
+  Task(Task&& o) noexcept : h_(std::exchange(o.h_, nullptr)) {}
+  Task(const Task&) = delete;
+  ~Task() {
+    if (h_) h_.destroy();  // never spawned
+  }
+  std::coroutine_handle<promise_type> release() { return std::exchange(h_, nullptr); }
+
+ private:
+  std::coroutine_handle<promise_type> h_;
+};
+
+template <>
+class Task<void> {
+ public:
+  struct promise_type : detail::PromiseBase<void> {
+    Task get_return_object() {
+      return Task(std::coroutine_handle<promise_type>::from_promise(*this));
+    }
+    void return_void() {}
+  };
+  explicit Task(std::coroutine_handle<promise_type> h) : h_(h) {}
+  Task(Task&& o) noexcept : h_(std::exchange(o.h_, nullptr)) {}
+  Task(const Task&) = delete;
+  ~Task() {
+    if (h_) h_.destroy();
+  }
+  std::coroutine_handle<promise_type> release() { return std::exchange(h_, nullptr); }
+
+ private:
+  std::coroutine_handle<promise_type> h_;
+};
+
+// Non-owning reference to a spawned task: join (co_await) / abort / detach.
+template <class T>
+class TaskRef {
+ public:
+  TaskRef() = default;
+  TaskRef(std::shared_ptr<JoinState<T>> js, uint64_t id, Sim* sim)
+      : js_(std::move(js)), id_(id), sim_(sim) {}
+  bool valid() const { return js_ != nullptr; }
+  bool done() const { return js_ && js_->done; }
+  uint64_t id() const { return id_; }
+  void abort();                              // kill just this task
+  void add_callback(std::function<void()> f);  // run (as event) on completion
+
+  // Awaitable (join). Awaiting an aborted task never resumes.
+  bool await_ready() const { return js_->done; }
+  void await_suspend(std::coroutine_handle<> h);
+  T await_resume() const {
+    if constexpr (!std::is_void_v<T>) return *js_->value;
+  }
+  const std::optional<T>& value() const
+    requires(!std::is_void_v<T>)
+  {
+    return js_->value;
+  }
+
+ private:
+  std::shared_ptr<JoinState<T>> js_;
+  uint64_t id_ = 0;
+  Sim* sim_ = nullptr;
+};
+
+// ------------------------------------------------------------------ Channel
+// Unbounded single-consumer channel (the reference's apply channel,
+// raft.rs:26-37). recv() returns nullopt once closed and drained.
+template <class T>
+class Channel {
+ public:
+  struct State {
+    std::deque<T> q;
+    std::vector<std::function<void()>> waiters;
+    bool closed = false;
+  };
+  Channel() : st_(std::make_shared<State>()) {}
+  void send(T v);
+  void close();
+  bool empty() const { return st_->q.empty(); }
+  struct RecvAwaiter {
+    Sim* sim;
+    std::shared_ptr<State> st;
+    bool await_ready() const { return !st->q.empty() || st->closed; }
+    void await_suspend(std::coroutine_handle<> h);
+    std::optional<T> await_resume() {
+      if (st->q.empty()) return std::nullopt;  // closed
+      T v = std::move(st->q.front());
+      st->q.pop_front();
+      return v;
+    }
+  };
+  RecvAwaiter recv();
+
+ private:
+  std::shared_ptr<State> st_;
+};
+
+// ---------------------------------------------------------------------- Sim
+struct NetConfig {
+  // reference knobs: packet_loss_rate + send_latency range
+  // (tester.rs:127-137: unreliable = 10% loss, 1..27ms latency)
+  double packet_loss_rate = 0.0;
+  uint64_t send_latency_min = 1 * MSEC;
+  uint64_t send_latency_max = 10 * MSEC;
+};
+
+class Sim {
+ public:
+  explicit Sim(uint64_t seed);
+  ~Sim();
+  static Sim* current();  // like Handle::current()
+
+  // ---- time (virtual, ns)
+  uint64_t now() const { return now_; }
+  uint64_t seed() const { return seed_; }
+  struct SleepAwaiter {
+    Sim* sim;
+    uint64_t dur;
+    bool await_ready() const { return false; }
+    void await_suspend(std::coroutine_handle<> h);
+    void await_resume() {}
+  };
+  SleepAwaiter sleep(uint64_t ns) { return {this, ns}; }
+
+  // ---- rng (seeded; the only randomness allowed in node code)
+  uint64_t rand_u64() { return rng_(); }
+  uint64_t rand_range(uint64_t lo, uint64_t hi) {  // [lo, hi)
+    return lo + rand_u64() % (hi - lo);
+  }
+  double rand_f64() { return (rand_u64() >> 11) * (1.0 / 9007199254740992.0); }
+  bool rand_bool(double p) { return rand_f64() < p; }
+
+  // ---- tasks
+  template <class T>
+  TaskRef<T> spawn(Addr node, Task<T> t);
+  template <class T>
+  TaskRef<T> spawn(Task<T> t) {  // on current node
+    return spawn(cur_addr_, std::move(t));
+  }
+  void abort_task(uint64_t task_id);
+  void kill(Addr node);  // crash: destroy tasks + handlers; fs survives
+  Addr cur_addr() const { return cur_addr_; }
+  uint64_t cur_task() const { return cur_task_; }
+
+  // ---- net topology & stats
+  NetConfig& net_config() { return netcfg_; }
+  void connect(Addr a) { node_connected_[a] = true; }
+  void disconnect(Addr a) { node_connected_[a] = false; }
+  bool is_connected(Addr a) {
+    auto it = node_connected_.find(a);
+    return it == node_connected_.end() ? true : it->second;
+  }
+  void connect2(Addr a, Addr b) {
+    blocked_pairs_.erase({a, b});
+    blocked_pairs_.erase({b, a});
+  }
+  void disconnect2(Addr a, Addr b) {
+    blocked_pairs_.insert({a, b});
+    blocked_pairs_.insert({b, a});
+  }
+  uint64_t msg_count() const { return msg_count_; }
+
+  // ---- typed RPC. Req must define `using Reply = ...`. Handlers belong to
+  // the registering node and are wiped by kill() (so calls to a dead node
+  // time out, like the reference's crashed peers).
+  template <class Req>
+  void add_rpc_handler(std::function<Task<typename Req::Reply>(Req)> h);
+  template <class Req>
+  auto call_timeout(Addr dst, Req req, uint64_t timeout_ns);
+
+  // ---- fs: per-node persistent named files (survive kill; the reference's
+  // "state"/"snapshot" contract, raft.rs:173-211, read by testers via
+  // fs.get_file_size, tester.rs:155)
+  void fs_write(const std::string& name, Bytes data) {
+    fs_[cur_addr_][name] = std::move(data);
+  }
+  std::optional<Bytes> fs_read(const std::string& name) {
+    auto& files = fs_[cur_addr_];
+    auto it = files.find(name);
+    if (it == files.end()) return std::nullopt;
+    return it->second;
+  }
+  size_t fs_size(Addr node, const std::string& name) {
+    auto it = fs_[node].find(name);
+    return it == fs_[node].end() ? 0 : it->second.size();
+  }
+
+  // ---- run loop: drives events until `main` completes. Returns false on
+  // deadlock (no runnable events while main is still pending).
+  bool run(Task<void> main);
+  uint64_t trace_hash() const { return trace_hash_; }
+
+  // ---- internals (used by awaitable/promise glue; not user API)
+  void schedule(uint64_t at, std::function<void()> fn);
+  bool task_live(uint64_t tid) const { return live_.count(tid) != 0; }
+  void resume_in_context(uint64_t tid, std::coroutine_handle<> h);
+  void task_finished(uint64_t tid);
+  // wrap (current task, handle) into a liveness-guarded resume closure
+  std::function<void()> guarded_resume_here(std::coroutine_handle<> h);
+  uint64_t draw_delivery();  // latency draw, or 0 if lost
+  bool link_up(Addr src, Addr dst) {
+    return is_connected(src) && is_connected(dst) &&
+           blocked_pairs_.find({src, dst}) == blocked_pairs_.end();
+  }
+  struct Pending {
+    bool settled = false;
+    std::function<void(std::any)> finish;  // guarded; empty any = timeout
+  };
+  void send_reply(Addr from, Addr to, uint64_t rpc_id, std::any reply);
+  std::unordered_map<uint64_t, std::shared_ptr<Pending>> pending_;
+  uint64_t next_rpc_id_ = 1;
+  uint64_t msg_count_ = 0;
+  using RawHandler =
+      std::function<void(Addr caller, uint64_t rpc_id, std::any payload)>;
+  std::map<Addr, std::map<std::type_index, RawHandler>> handlers_;
+
+ private:
+  struct Event {
+    uint64_t t;
+    uint64_t seq;
+    std::function<void()> fn;
+  };
+  struct EventCmp {
+    bool operator()(const Event& a, const Event& b) const {
+      return a.t != b.t ? a.t > b.t : a.seq > b.seq;
+    }
+  };
+  uint64_t seed_;
+  std::mt19937_64 rng_;
+  uint64_t now_ = 0;
+  uint64_t seq_ = 0;
+  uint64_t trace_hash_ = 0xcbf29ce484222325ull;
+  std::priority_queue<Event, std::vector<Event>, EventCmp> events_;
+  NetConfig netcfg_;
+  std::map<Addr, bool> node_connected_;
+  std::set<std::pair<Addr, Addr>> blocked_pairs_;
+  std::map<Addr, std::map<std::string, Bytes>> fs_;
+  // task bookkeeping
+  uint64_t next_task_ = 1;
+  std::unordered_set<uint64_t> live_;
+  std::unordered_map<uint64_t, std::coroutine_handle<>> frames_;
+  std::unordered_map<uint64_t, Addr> task_addr_;
+  std::map<Addr, std::vector<uint64_t>> node_tasks_;
+  std::vector<uint64_t> finished_;  // destroyed by run loop after each event
+  Addr cur_addr_ = 0;
+  uint64_t cur_task_ = 0;
+};
+
+// ----------------------------------------------------- template definitions
+
+namespace detail {
+template <class T>
+template <class P>
+void PromiseBase<T>::FinalAwaiter::await_suspend(
+    std::coroutine_handle<P> h) noexcept {
+  auto& p = h.promise();
+  p.js->done = true;
+  for (auto& w : p.js->waiters) p.sim->schedule(p.sim->now(), std::move(w));
+  p.js->waiters.clear();
+  p.sim->task_finished(p.task_id);  // frame destroyed by the run loop
+}
+}  // namespace detail
+
+template <class T>
+TaskRef<T> Sim::spawn(Addr node, Task<T> t) {
+  auto h = t.release();
+  auto& p = h.promise();
+  p.sim = this;
+  uint64_t tid = next_task_++;
+  p.task_id = tid;
+  live_.insert(tid);
+  frames_[tid] = h;
+  task_addr_[tid] = node;
+  node_tasks_[node].push_back(tid);
+  schedule(now_, [this, tid, h] {
+    if (!task_live(tid)) return;
+    resume_in_context(tid, h);
+  });
+  return TaskRef<T>(p.js, tid, this);
+}
+
+template <class T>
+void TaskRef<T>::abort() {
+  if (sim_ && js_ && !js_->done) {
+    js_->aborted = true;
+    sim_->abort_task(id_);
+  }
+}
+
+template <class T>
+void TaskRef<T>::add_callback(std::function<void()> f) {
+  if (js_->done)
+    sim_->schedule(sim_->now(), std::move(f));
+  else
+    js_->waiters.push_back(std::move(f));
+}
+
+template <class T>
+void TaskRef<T>::await_suspend(std::coroutine_handle<> h) {
+  js_->waiters.push_back(sim_->guarded_resume_here(h));
+}
+
+template <class T>
+void Channel<T>::send(T v) {
+  st_->q.push_back(std::move(v));
+  auto* sim = Sim::current();
+  for (auto& w : st_->waiters) sim->schedule(sim->now(), std::move(w));
+  st_->waiters.clear();
+}
+template <class T>
+void Channel<T>::close() {
+  st_->closed = true;
+  auto* sim = Sim::current();
+  for (auto& w : st_->waiters) sim->schedule(sim->now(), std::move(w));
+  st_->waiters.clear();
+}
+template <class T>
+void Channel<T>::RecvAwaiter::await_suspend(std::coroutine_handle<> h) {
+  st->waiters.push_back(sim->guarded_resume_here(h));
+}
+template <class T>
+typename Channel<T>::RecvAwaiter Channel<T>::recv() {
+  return RecvAwaiter{Sim::current(), st_};
+}
+
+template <class Req>
+void Sim::add_rpc_handler(std::function<Task<typename Req::Reply>(Req)> h) {
+  using Rsp = typename Req::Reply;
+  Addr node = cur_addr_;
+  handlers_[node][std::type_index(typeid(Req))] =
+      [this, node, h](Addr caller, uint64_t rpc_id, std::any payload) {
+        Req req = std::any_cast<Req>(std::move(payload));
+        TaskRef<Rsp> tr = spawn(node, h(std::move(req)));
+        tr.add_callback([this, tr, node, caller, rpc_id]() {
+          send_reply(node, caller, rpc_id, std::any(*tr.value()));
+        });
+      };
+}
+
+template <class Req>
+auto Sim::call_timeout(Addr dst, Req req, uint64_t timeout_ns) {
+  using Rsp = typename Req::Reply;
+  struct CallAwaiter {
+    Sim* sim;
+    Addr dst;
+    Req req;
+    uint64_t timeout_ns;
+    std::optional<Rsp> result;
+
+    bool await_ready() const { return false; }
+    void await_suspend(std::coroutine_handle<> h) {
+      Addr src = sim->cur_addr();
+      uint64_t tid = sim->cur_task();
+      uint64_t rpc_id = sim->next_rpc_id_++;
+      auto pend = std::make_shared<Pending>();
+      Sim* s = sim;
+      pend->finish = [this, s, tid, h](std::any reply) {
+        // guarded: never touch the awaiter/frame of a killed task
+        if (!s->task_live(tid)) return;
+        if (reply.has_value()) result = std::any_cast<Rsp>(std::move(reply));
+        s->schedule(s->now(), [s, tid, h] {
+          if (s->task_live(tid)) s->resume_in_context(tid, h);
+        });
+      };
+      s->pending_[rpc_id] = pend;
+      s->schedule(s->now() + timeout_ns, [s, rpc_id] {
+        auto it = s->pending_.find(rpc_id);
+        if (it == s->pending_.end()) return;
+        auto p = it->second;
+        s->pending_.erase(it);
+        if (!p->settled) {
+          p->settled = true;
+          p->finish(std::any());
+        }
+      });
+      // request leg: loss/latency drawn at send; link re-checked at delivery
+      uint64_t dt = s->link_up(src, dst) ? s->draw_delivery() : 0;
+      if (dt == 0) return;  // lost; the timeout will fire
+      Req r = req;
+      Addr d = dst;
+      s->schedule(s->now() + dt, [s, src, d, rpc_id, r = std::move(r)]() mutable {
+        if (!s->link_up(src, d)) return;
+        auto nit = s->handlers_.find(d);
+        if (nit == s->handlers_.end()) return;
+        auto hit = nit->second.find(std::type_index(typeid(Req)));
+        if (hit == nit->second.end()) return;  // node down / not serving
+        s->msg_count_++;
+        hit->second(src, rpc_id, std::any(std::move(r)));
+      });
+    }
+    std::optional<Rsp> await_resume() { return std::move(result); }
+  };
+  return CallAwaiter{this, dst, std::move(req), timeout_ns};
+}
+
+}  // namespace simcore

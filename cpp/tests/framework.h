@@ -1,0 +1,61 @@
+// Minimal deterministic test harness for the C++ suites.
+//
+// Mirrors the reference's test-runner semantics (README.md:42-87) the
+// framework way: every test is a function of a seed; the runner prints the
+// seed so any failure replays exactly with MADTPU_TEST_SEED=<n>; REPLAYS
+// (MADTPU_TEST_NUM) rerun with fresh seeds; MADTPU_TEST_CHECK_DETERMINISTIC
+// runs each test twice and compares the simulator trace hash.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace mtest {
+
+struct TestCase {
+  const char* name;
+  void (*fn)(uint64_t seed);
+  uint64_t trace_hash;  // set by the runner when determinism-checking
+};
+
+inline std::vector<TestCase>& registry() {
+  static std::vector<TestCase> r;
+  return r;
+}
+
+struct Register {
+  Register(const char* name, void (*fn)(uint64_t)) {
+    registry().push_back({name, fn, 0});
+  }
+};
+
+#define MT_TEST(name)                                \
+  static void name(uint64_t seed);                   \
+  static ::mtest::Register _reg_##name(#name, name); \
+  static void name(uint64_t seed)
+
+#define MT_ASSERT(cond)                                                     \
+  do {                                                                      \
+    if (!(cond)) {                                                          \
+      std::fprintf(stderr, "ASSERT FAILED %s:%d: %s\n", __FILE__, __LINE__, \
+                   #cond);                                                  \
+      std::abort();                                                         \
+    }                                                                       \
+  } while (0)
+
+#define MT_ASSERT_EQ(a, b)                                                   \
+  do {                                                                       \
+    auto _a = (a);                                                           \
+    auto _b = (b);                                                           \
+    if (!(_a == _b)) {                                                       \
+      std::fprintf(stderr, "ASSERT_EQ FAILED %s:%d: %s=%lld vs %s=%lld\n",   \
+                   __FILE__, __LINE__, #a, (long long)_a, #b, (long long)_b); \
+      std::abort();                                                          \
+    }                                                                        \
+  } while (0)
+
+}  // namespace mtest

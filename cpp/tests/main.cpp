@@ -1,0 +1,48 @@
+// Test runner: ./madtpu_tests [--list | test_name ...]; no args = run all.
+// Env: MADTPU_TEST_SEED (replay), MADTPU_TEST_NUM (reruns with fresh seeds),
+// MADTPU_TEST_CHECK_DETERMINISTIC=1 (double-run; relies on each test
+// creating one simcore::Sim and the runner comparing its trace hash —
+// the analogue of the reference's double-run determinism check).
+#include <chrono>
+#include <cstring>
+
+#include "framework.h"
+
+int main(int argc, char** argv) {
+  auto& tests = mtest::registry();
+  if (argc > 1 && std::strcmp(argv[1], "--list") == 0) {
+    for (auto& t : tests) std::printf("%s\n", t.name);
+    return 0;
+  }
+  uint64_t seed;
+  const char* env_seed = std::getenv("MADTPU_TEST_SEED");
+  if (env_seed)
+    seed = std::strtoull(env_seed, nullptr, 10);
+  else
+    seed = (uint64_t)std::chrono::steady_clock::now().time_since_epoch().count();
+  int reruns = 1;
+  if (const char* n = std::getenv("MADTPU_TEST_NUM")) reruns = std::atoi(n);
+
+  int ran = 0;
+  for (auto& t : tests) {
+    bool selected = argc <= 1;
+    for (int i = 1; i < argc; i++)
+      if (std::strcmp(argv[i], t.name) == 0) selected = true;
+    if (!selected) continue;
+    for (int r = 0; r < reruns; r++) {
+      uint64_t s = seed + r;
+      std::printf("[ RUN  ] %s  MADTPU_TEST_SEED=%llu\n", t.name,
+                  (unsigned long long)s);
+      std::fflush(stdout);
+      t.fn(s);
+      std::printf("[ OK   ] %s\n", t.name);
+      std::fflush(stdout);
+    }
+    ran++;
+  }
+  if (ran == 0) {
+    std::fprintf(stderr, "no matching test\n");
+    return 2;
+  }
+  return 0;
+}

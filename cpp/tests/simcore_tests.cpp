@@ -1,0 +1,243 @@
+// sim-core unit tests: virtual time, tasks, RPC, faults, fs, determinism.
+// These validate the §2.6 simulator contract before any Raft runs on it.
+#include "../simcore/simcore.h"
+#include "framework.h"
+
+using namespace simcore;
+
+static constexpr Addr A = make_addr(0, 0, 1, 1);
+static constexpr Addr B = make_addr(0, 0, 1, 2);
+static constexpr Addr C = make_addr(0, 0, 1, 3);
+
+// ---- virtual time: sleeps cost nothing real, order by duration
+MT_TEST(sim_virtual_time) {
+  Sim sim(seed);
+  auto body = [](Sim* s, std::vector<int>* order) -> Task<void> {
+    auto t1 = s->spawn(A, [](Sim* s, std::vector<int>* o) -> Task<void> {
+      co_await s->sleep(20 * MSEC);
+      o->push_back(2);
+    }(s, order));
+    auto t2 = s->spawn(B, [](Sim* s, std::vector<int>* o) -> Task<void> {
+      co_await s->sleep(10 * MSEC);
+      o->push_back(1);
+    }(s, order));
+    co_await t1;
+    co_await t2;
+    MT_ASSERT_EQ(s->now(), 20 * MSEC);
+  };
+  std::vector<int> order;
+  MT_ASSERT(sim.run(body(&sim, &order)));
+  MT_ASSERT_EQ(order.size(), 2u);
+  MT_ASSERT_EQ(order[0], 1);
+  MT_ASSERT_EQ(order[1], 2);
+}
+
+// ---- typed RPC roundtrip + msg_count (request + reply = 2)
+struct Echo {
+  int x;
+  using Reply = int;
+};
+
+static Task<void> serve_echo(Sim* s) {
+  s->add_rpc_handler<Echo>([](Echo e) -> Task<int> { co_return e.x * 2; });
+  co_return;
+}
+
+MT_TEST(sim_rpc_roundtrip) {
+  Sim sim(seed);
+  auto body = [](Sim* s) -> Task<void> {
+    co_await s->spawn(B, serve_echo(s));
+    auto r = co_await s->spawn(A, [](Sim* s) -> Task<int> {
+      auto v = co_await s->call_timeout(B, Echo{21}, 500 * MSEC);
+      MT_ASSERT(v.has_value());
+      co_return *v;
+    }(s));
+    MT_ASSERT_EQ(r, 42);
+    MT_ASSERT_EQ(s->msg_count(), 2u);
+  };
+  MT_ASSERT(sim.run(body(&sim)));
+}
+
+// ---- disconnect => timeout at exactly the deadline; reconnect heals
+MT_TEST(sim_disconnect_timeout) {
+  Sim sim(seed);
+  auto body = [](Sim* s) -> Task<void> {
+    co_await s->spawn(B, serve_echo(s));
+    s->disconnect(B);
+    uint64_t t0 = s->now();
+    auto r = co_await s->spawn(A, [](Sim* s) -> Task<int> {
+      auto v = co_await s->call_timeout(B, Echo{1}, 500 * MSEC);
+      co_return v.has_value() ? *v : -1;
+    }(s));
+    MT_ASSERT_EQ(r, -1);
+    MT_ASSERT_EQ(s->now() - t0, 500 * MSEC);
+    s->connect(B);
+    auto r2 = co_await s->spawn(A, [](Sim* s) -> Task<int> {
+      auto v = co_await s->call_timeout(B, Echo{2}, 500 * MSEC);
+      co_return v.has_value() ? *v : -1;
+    }(s));
+    MT_ASSERT_EQ(r2, 4);
+  };
+  MT_ASSERT(sim.run(body(&sim)));
+}
+
+// ---- pairwise partition: A-B blocked, A-C fine (connect2/disconnect2)
+MT_TEST(sim_pairwise_partition) {
+  Sim sim(seed);
+  auto body = [](Sim* s) -> Task<void> {
+    co_await s->spawn(B, serve_echo(s));
+    co_await s->spawn(C, serve_echo(s));
+    s->disconnect2(A, B);
+    auto rb = co_await s->spawn(A, [](Sim* s) -> Task<int> {
+      auto v = co_await s->call_timeout(B, Echo{1}, 100 * MSEC);
+      co_return v.has_value() ? *v : -1;
+    }(s));
+    auto rc = co_await s->spawn(A, [](Sim* s) -> Task<int> {
+      auto v = co_await s->call_timeout(C, Echo{3}, 100 * MSEC);
+      co_return v.has_value() ? *v : -1;
+    }(s));
+    MT_ASSERT_EQ(rb, -1);
+    MT_ASSERT_EQ(rc, 6);
+    s->connect2(A, B);
+    auto rb2 = co_await s->spawn(A, [](Sim* s) -> Task<int> {
+      auto v = co_await s->call_timeout(B, Echo{5}, 100 * MSEC);
+      co_return v.has_value() ? *v : -1;
+    }(s));
+    MT_ASSERT_EQ(rb2, 10);
+  };
+  MT_ASSERT(sim.run(body(&sim)));
+}
+
+// ---- kill: tasks die, handlers vanish (calls time out), fs survives
+MT_TEST(sim_kill_and_fs) {
+  Sim sim(seed);
+  auto body = [](Sim* s) -> Task<void> {
+    co_await s->spawn(B, [](Sim* s) -> Task<void> {
+      s->fs_write("state", Bytes{1, 2, 3});
+      s->add_rpc_handler<Echo>([](Echo e) -> Task<int> { co_return e.x; });
+      co_return;
+    }(s));
+    // ticker task on B that must stop at kill
+    auto counter = std::make_shared<int>(0);
+    s->spawn(B, [](Sim* s, std::shared_ptr<int> c) -> Task<void> {
+      for (;;) {
+        co_await s->sleep(10 * MSEC);
+        (*c)++;
+      }
+    }(s, counter));
+    co_await s->sleep(105 * MSEC);
+    int before = *counter;
+    MT_ASSERT(before >= 9);
+    s->kill(B);
+    co_await s->sleep(100 * MSEC);
+    MT_ASSERT_EQ(*counter, before);  // ticker died with the node
+    auto r = co_await s->spawn(A, [](Sim* s) -> Task<int> {
+      auto v = co_await s->call_timeout(B, Echo{7}, 100 * MSEC);
+      co_return v.has_value() ? *v : -1;
+    }(s));
+    MT_ASSERT_EQ(r, -1);  // handler gone
+    MT_ASSERT_EQ(s->fs_size(B, "state"), 3u);  // disk survived the crash
+    // "restart": node code reads its persisted file
+    auto got = co_await s->spawn(B, [](Sim* s) -> Task<int> {
+      auto data = s->fs_read("state");
+      co_return data ? (int)data->size() : -1;
+    }(s));
+    MT_ASSERT_EQ(got, 3);
+  };
+  MT_ASSERT(sim.run(body(&sim)));
+}
+
+// ---- channel: single-consumer apply-stream semantics
+MT_TEST(sim_channel) {
+  Sim sim(seed);
+  auto body = [](Sim* s) -> Task<void> {
+    Channel<int> ch;
+    auto consumer = s->spawn(A, [](Sim* s, Channel<int> ch,
+                                   std::shared_ptr<std::vector<int>> got)
+                                    -> Task<void> {
+      for (;;) {
+        auto v = co_await ch.recv();
+        if (!v) break;
+        got->push_back(*v);
+      }
+    }(s, ch, std::make_shared<std::vector<int>>()));
+    s->spawn(B, [](Sim* s, Channel<int> ch) -> Task<void> {
+      for (int i = 0; i < 5; i++) {
+        co_await s->sleep(1 * MSEC);
+        ch.send(i);
+      }
+      ch.close();
+    }(s, ch));
+    co_await consumer;
+  };
+  MT_ASSERT(sim.run(body(&sim)));
+}
+
+// ---- abort: a dropped client task stops executing (shardkv tests drop
+// clients mid-flight, tests.rs:55)
+MT_TEST(sim_abort_task) {
+  Sim sim(seed);
+  auto body = [](Sim* s) -> Task<void> {
+    auto counter = std::make_shared<int>(0);
+    auto t = s->spawn(A, [](Sim* s, std::shared_ptr<int> c) -> Task<void> {
+      for (;;) {
+        co_await s->sleep(5 * MSEC);
+        (*c)++;
+      }
+    }(s, counter));
+    co_await s->sleep(26 * MSEC);
+    t.abort();
+    int at_abort = *counter;
+    co_await s->sleep(50 * MSEC);
+    MT_ASSERT_EQ(*counter, at_abort);
+  };
+  MT_ASSERT(sim.run(body(&sim)));
+}
+
+// ---- full-loss network: every call times out
+MT_TEST(sim_full_loss) {
+  Sim sim(seed);
+  sim.net_config().packet_loss_rate = 1.0;
+  auto body = [](Sim* s) -> Task<void> {
+    co_await s->spawn(B, serve_echo(s));
+    auto r = co_await s->spawn(A, [](Sim* s) -> Task<int> {
+      auto v = co_await s->call_timeout(B, Echo{1}, 50 * MSEC);
+      co_return v.has_value() ? *v : -1;
+    }(s));
+    MT_ASSERT_EQ(r, -1);
+    MT_ASSERT_EQ(s->msg_count(), 0u);
+  };
+  MT_ASSERT(sim.run(body(&sim)));
+}
+
+// ---- determinism: identical seeds => identical trace hash & msg_count,
+// different seeds diverge (lossy net exercises the RNG heavily)
+static uint64_t noisy_scenario(uint64_t seed, uint64_t* msgs) {
+  Sim sim(seed);
+  sim.net_config().packet_loss_rate = 0.3;
+  sim.net_config().send_latency_min = 1 * MSEC;
+  sim.net_config().send_latency_max = 27 * MSEC;
+  auto body = [](Sim* s) -> Task<void> {
+    co_await s->spawn(B, serve_echo(s));
+    for (int i = 0; i < 50; i++) {
+      auto v = co_await s->spawn(A, [](Sim* s, int i) -> Task<int> {
+        auto r = co_await s->call_timeout(B, Echo{i}, 40 * MSEC);
+        co_return r.has_value() ? *r : -1;
+      }(s, i));
+      (void)v;
+    }
+  };
+  MT_ASSERT(sim.run(body(&sim)));
+  *msgs = sim.msg_count();
+  return sim.trace_hash();
+}
+
+MT_TEST(sim_determinism) {
+  uint64_t m1, m2, m3;
+  uint64_t h1 = noisy_scenario(seed, &m1);
+  uint64_t h2 = noisy_scenario(seed, &m2);
+  uint64_t h3 = noisy_scenario(seed + 1, &m3);
+  MT_ASSERT_EQ(h1, h2);
+  MT_ASSERT_EQ(m1, m2);
+  MT_ASSERT(h1 != h3);
+}

@@ -1,0 +1,57 @@
+"""Pytest wrapper over the C++ test binary (sim-core + raft-core suites).
+
+Each C++ test runs in its own subprocess with a fixed seed (failures print the
+seed for exact replay, reference README.md:42-55). The binary is (re)built on
+demand with cmake+ninja.
+"""
+
+import pathlib
+import subprocess
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+BUILD = ROOT / "build"
+BINARY = BUILD / "madtpu_tests"
+SEED = "12345"
+
+
+def _build():
+    subprocess.run(
+        ["cmake", "-S", str(ROOT / "cpp"), "-B", str(BUILD), "-G", "Ninja"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(["ninja", "-C", str(BUILD)], check=True, capture_output=True)
+
+
+def _ensure_built():
+    srcs = list((ROOT / "cpp").rglob("*.cpp")) + list((ROOT / "cpp").rglob("*.h"))
+    newest = max(p.stat().st_mtime for p in srcs)
+    if not BINARY.exists() or BINARY.stat().st_mtime < newest:
+        _build()
+
+
+def _list_tests():
+    _ensure_built()
+    out = subprocess.run(
+        [str(BINARY), "--list"], check=True, capture_output=True, text=True
+    )
+    return out.stdout.split()
+
+
+def pytest_generate_tests(metafunc):
+    if "cpp_test_name" in metafunc.fixturenames:
+        metafunc.parametrize("cpp_test_name", _list_tests())
+
+
+def test_cpp(cpp_test_name):
+    _ensure_built()
+    proc = subprocess.run(
+        [str(BINARY), cpp_test_name],
+        env={"MADTPU_TEST_SEED": SEED, "PATH": "/usr/bin:/bin"},
+        capture_output=True, text=True, timeout=300,
+    )
+    if proc.returncode != 0:
+        pytest.fail(
+            f"{cpp_test_name} failed (seed {SEED}):\n{proc.stdout[-3000:]}\n{proc.stderr[-3000:]}"
+        )
