@@ -1,0 +1,68 @@
+// Tiny deterministic binary codec for persistence and snapshots.
+//
+// The reference encodes its persistent state and wire values with bincode
+// (/root/reference/src/raft/raft.rs:176,204); in-process RPC payloads here are
+// typed C++ values (serialization is semantically irrelevant in-sim, see
+// simcore.h), so this codec exists only for the on-"disk" byte contract:
+// the "state"/"snapshot" files whose sizes the testers assert on
+// (/root/reference/src/raft/tester.rs:152-158).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace raftcore {
+
+using Bytes = std::vector<uint8_t>;
+
+struct Enc {
+  Bytes out;
+  void u64(uint64_t v) {
+    for (int i = 0; i < 8; i++) out.push_back(uint8_t(v >> (8 * i)));
+  }
+  void bytes(const Bytes& b) {
+    u64(b.size());
+    out.insert(out.end(), b.begin(), b.end());
+  }
+  void str(const std::string& s) {
+    u64(s.size());
+    out.insert(out.end(), s.begin(), s.end());
+  }
+};
+
+struct Dec {
+  const Bytes* in;
+  size_t pos = 0;
+  explicit Dec(const Bytes& b) : in(&b) {}
+  uint64_t u64() {
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++) v |= uint64_t((*in)[pos++]) << (8 * i);
+    return v;
+  }
+  Bytes bytes() {
+    size_t n = u64();
+    Bytes b(in->begin() + pos, in->begin() + pos + n);
+    pos += n;
+    return b;
+  }
+  std::string str() {
+    size_t n = u64();
+    std::string s(in->begin() + pos, in->begin() + pos + n);
+    pos += n;
+    return s;
+  }
+  bool done() const { return pos >= in->size(); }
+};
+
+inline Bytes enc_u64(uint64_t v) {
+  Enc e;
+  e.u64(v);
+  return e.out;
+}
+inline uint64_t dec_u64(const Bytes& b) {
+  Dec d(b);
+  return d.u64();
+}
+
+}  // namespace raftcore

@@ -1,0 +1,417 @@
+#include "raft.h"
+
+#include <algorithm>
+
+namespace raftcore {
+
+// ------------------------------------------------------------------- boot
+
+Task<std::shared_ptr<Raft>> Raft::boot(Sim* sim, std::vector<Addr> peers,
+                                       size_t me, Channel<ApplyMsg> apply_ch) {
+  auto rf = std::shared_ptr<Raft>(
+      new Raft(sim, std::move(peers), me, std::move(apply_ch)));
+  rf->next_idx_.assign(rf->peers_.size(), 1);
+  rf->match_idx_.assign(rf->peers_.size(), 0);
+  rf->sent_commit_.assign(rf->peers_.size(), 0);
+  rf->restore();
+  // Deliver the restored snapshot to the service before any command
+  // (the apply channel is FIFO, so the service sees it first — the
+  // reference's restore() path, raft.rs:194-211).
+  if (rf->snap_last_index_ > 0) {
+    rf->apply_ch_.send(ApplyMsg{true, rf->snap_data_, rf->snap_last_index_,
+                                rf->snap_last_term_});
+    rf->commit_ = rf->snap_last_index_;
+    rf->last_applied_ = rf->snap_last_index_;
+  }
+  rf->register_handlers();
+  rf->reset_election_deadline();
+  sim->spawn(rf->addr_, election_loop(rf));
+  co_return rf;
+}
+
+// ---------------------------------------------------------------- handlers
+
+namespace {
+// Handler coroutines are free functions taking the shared_ptr by value: the
+// coroutine frame owns its own reference, so a handler re-registration (which
+// destroys the capturing closure) can never dangle a running handler.
+Task<RequestVoteReply> rv_handler(std::shared_ptr<Raft> rf,
+                                  RequestVoteArgs a,
+                                  RequestVoteReply (Raft::*fn)(const RequestVoteArgs&)) {
+  co_return(rf.get()->*fn)(a);
+}
+Task<AppendEntriesReply> ae_handler(
+    std::shared_ptr<Raft> rf, AppendEntriesArgs a,
+    AppendEntriesReply (Raft::*fn)(const AppendEntriesArgs&)) {
+  co_return(rf.get()->*fn)(a);
+}
+Task<InstallSnapshotReply> is_handler(
+    std::shared_ptr<Raft> rf, InstallSnapshotArgs a,
+    InstallSnapshotReply (Raft::*fn)(const InstallSnapshotArgs&)) {
+  co_return(rf.get()->*fn)(a);
+}
+}  // namespace
+
+void Raft::register_handlers() {
+  // net.add_rpc_handler pattern (raft.rs:213-222); runs in boot's node context
+  auto self = shared_from_this();
+  sim_->add_rpc_handler<RequestVoteArgs>(
+      [self](RequestVoteArgs a) -> Task<RequestVoteReply> {
+        return rv_handler(self, std::move(a), &Raft::handle_request_vote);
+      });
+  sim_->add_rpc_handler<AppendEntriesArgs>(
+      [self](AppendEntriesArgs a) -> Task<AppendEntriesReply> {
+        return ae_handler(self, std::move(a), &Raft::handle_append_entries);
+      });
+  sim_->add_rpc_handler<InstallSnapshotArgs>(
+      [self](InstallSnapshotArgs a) -> Task<InstallSnapshotReply> {
+        return is_handler(self, std::move(a), &Raft::handle_install_snapshot);
+      });
+}
+
+RequestVoteReply Raft::handle_request_vote(const RequestVoteArgs& a) {
+  uint64_t term0 = term_;
+  int voted0 = voted_for_;
+  if (a.term > term_) step_down(a.term);
+  bool grant = false;
+  if (a.term == term_ && (voted_for_ == -1 || voted_for_ == (int)a.candidate)) {
+    // election restriction (§5.4.1): candidate's log at least as up-to-date
+    uint64_t my_llt = term_at(last_index());
+    if (a.last_log_term > my_llt ||
+        (a.last_log_term == my_llt && a.last_log_index >= last_index())) {
+      grant = true;
+      voted_for_ = (int)a.candidate;
+      reset_election_deadline();
+    }
+  }
+  if (term_ != term0 || voted_for_ != voted0)
+    persist();  // before the reply leaves the node (raft.rs:224-233)
+  return {term_, grant};
+}
+
+AppendEntriesReply Raft::handle_append_entries(const AppendEntriesArgs& a) {
+  if (a.term < term_) return {term_, false, 0};
+  uint64_t term0 = term_;
+  bool log_dirty = false;
+  if (a.term > term_) step_down(a.term);
+  if (role_ == Role::Candidate) role_ = Role::Follower;
+  leader_hint_ = (int)a.leader;
+  reset_election_deadline();
+
+  uint64_t prev_index = a.prev_index;
+  size_t skip = 0;  // entries already covered by our snapshot
+  if (prev_index < snap_last_index_) {
+    // stale retransmit reaching into our compacted prefix: everything up to
+    // the snapshot is committed, so just skip that part of the batch
+    skip = std::min<uint64_t>(snap_last_index_ - prev_index, a.entries.size());
+    prev_index = snap_last_index_;
+  }
+  if (prev_index > last_index()) {
+    if (term_ != term0) persist();
+    return {term_, false, last_index() + 1};
+  }
+  if (term_at(prev_index) != a.prev_term && prev_index > snap_last_index_) {
+    // fast backtrack: first index of the conflicting term
+    uint64_t ct = term_at(prev_index);
+    uint64_t first = prev_index;
+    while (first - 1 > snap_last_index_ && term_at(first - 1) == ct) first--;
+    if (term_ != term0) persist();
+    return {term_, false, first};
+  }
+  // append, truncating at the first conflict (never truncate on a match —
+  // a delayed short AE must not drop entries a newer one appended)
+  uint64_t idx = prev_index;
+  for (size_t k = skip; k < a.entries.size(); k++) {
+    idx = prev_index + (k - skip) + 1;
+    if (idx <= last_index()) {
+      if (term_at(idx) != a.entries[k].term) {
+        log_.resize(idx - snap_last_index_ - 1);
+        log_.push_back(a.entries[k]);
+        log_dirty = true;
+      }
+    } else {
+      log_.push_back(a.entries[k]);
+      log_dirty = true;
+    }
+  }
+  uint64_t last_new = prev_index + (a.entries.size() - skip);
+  if (a.leader_commit > commit_) {
+    commit_ = std::min(a.leader_commit, std::max(last_new, commit_));
+    commit_ = std::min(commit_, last_index());
+  }
+  if (term_ != term0 || log_dirty) persist();
+  apply_committed();
+  return {term_, true, last_new};
+}
+
+InstallSnapshotReply Raft::handle_install_snapshot(const InstallSnapshotArgs& a) {
+  if (a.term < term_) return {term_};
+  uint64_t term0 = term_;
+  if (a.term > term_) step_down(a.term);
+  if (role_ == Role::Candidate) role_ = Role::Follower;
+  leader_hint_ = (int)a.leader;
+  reset_election_deadline();
+  if (term_ != term0) persist();
+  // ignore snapshots that would regress the service's applied state
+  // (reorderings/retransmits on an unreliable net)
+  if (a.last_index <= last_applied_ || a.last_index <= snap_last_index_)
+    return {term_};
+  // hand to the service; it answers via cond_install_snapshot (raft.rs:149-168)
+  apply_ch_.send(ApplyMsg{true, a.data, a.last_index, a.last_term});
+  return {term_};
+}
+
+bool Raft::cond_install_snapshot(uint64_t last_term, uint64_t last_index,
+                                 Bytes data) {
+  if (last_index < snap_last_index_ || last_index < last_applied_) return false;
+  // keep our log suffix if it extends past the snapshot and matches its term
+  if (last_index <= this->last_index() && term_at(last_index) == last_term) {
+    log_.erase(log_.begin(),
+               log_.begin() + (last_index - snap_last_index_));
+  } else {
+    log_.clear();
+  }
+  snap_last_index_ = last_index;
+  snap_last_term_ = last_term;
+  snap_data_ = std::move(data);
+  snap_dirty_ = true;
+  commit_ = std::max(commit_, last_index);
+  last_applied_ = std::max(last_applied_, last_index);
+  persist();
+  return true;
+}
+
+void Raft::snapshot(uint64_t index, Bytes data) {
+  // service-triggered compaction (raft.rs:166); index is always <= applied
+  if (index <= snap_last_index_) return;
+  uint64_t t = term_at(index);
+  log_.erase(log_.begin(), log_.begin() + (index - snap_last_index_));
+  snap_last_index_ = index;
+  snap_last_term_ = t;
+  snap_data_ = std::move(data);
+  snap_dirty_ = true;
+  persist();
+}
+
+// ----------------------------------------------------------------- election
+
+Task<void> Raft::election_loop(std::shared_ptr<Raft> self) {
+  for (;;) {
+    co_await self->sim_->sleep(TICK);
+    if (self->role_ != Role::Leader &&
+        self->sim_->now() >= self->election_deadline_) {
+      self->start_election();
+    }
+  }
+}
+
+void Raft::start_election() {
+  term_++;
+  role_ = Role::Candidate;
+  voted_for_ = (int)me_;
+  votes_ = 1;
+  reset_election_deadline();
+  persist();  // before any RequestVote leaves (raft.rs:224-233)
+  auto self = shared_from_this();
+  for (size_t p = 0; p < peers_.size(); p++) {
+    if (p == me_) continue;
+    sim_->spawn(addr_, vote_task(self, peers_[p], term_));
+  }
+}
+
+Task<void> Raft::vote_task(std::shared_ptr<Raft> self, Addr peer,
+                           uint64_t term) {
+  RequestVoteArgs args{term, (uint32_t)self->me_, self->last_index(),
+                       self->term_at(self->last_index())};
+  auto r = co_await self->sim_->call_timeout(peer, args, RPC_TIMEOUT);
+  if (!r) co_return;
+  if (r->term > self->term_) {
+    self->step_down(r->term);
+    self->persist();
+    co_return;
+  }
+  if (self->role_ == Role::Candidate && self->term_ == term && r->granted) {
+    self->votes_++;
+    if (self->votes_ >= self->peers_.size() / 2 + 1) self->become_leader();
+  }
+}
+
+void Raft::become_leader() {
+  role_ = Role::Leader;
+  leader_hint_ = (int)me_;
+  for (size_t p = 0; p < peers_.size(); p++) {
+    next_idx_[p] = last_index() + 1;
+    match_idx_[p] = 0;
+    sent_commit_[p] = 0;  // forces an immediate announce-AE per peer
+  }
+  auto self = shared_from_this();
+  for (size_t p = 0; p < peers_.size(); p++) {
+    if (p == me_) continue;
+    sim_->spawn(addr_, replicator(self, p, term_));
+  }
+}
+
+void Raft::step_down(uint64_t new_term) {
+  // NOTE: does not touch the election deadline — the timer resets only on
+  // granting a vote or hearing from the current-term leader (Raft §5.2);
+  // resetting here would let an unelectable high-term disrupter postpone
+  // re-election indefinitely.
+  term_ = new_term;
+  role_ = Role::Follower;
+  voted_for_ = -1;
+}
+
+void Raft::reset_election_deadline() {
+  election_deadline_ =
+      sim_->now() + sim_->rand_range(ELECTION_MIN, ELECTION_MAX + 1);
+}
+
+// -------------------------------------------------------------- replication
+
+StartResult Raft::start(Bytes cmd) {
+  if (role_ != Role::Leader) return {false, 0, 0, leader_hint_};
+  log_.push_back(LogEntry{term_, std::move(cmd)});
+  persist();
+  advance_commit();  // single-node cluster commits immediately
+  return {true, last_index(), term_, (int)me_};
+}
+
+Task<void> Raft::replicator(std::shared_ptr<Raft> self, size_t p,
+                            uint64_t term) {
+  Addr peer = self->peers_[p];
+  uint64_t last_send = 0;
+  bool first = true;
+  while (self->role_ == Role::Leader && self->term_ == term) {
+    Sim* sim = self->sim_;
+    bool due = sim->now() >= last_send + HEARTBEAT;
+    bool fresh = self->last_index() >= self->next_idx_[p] ||
+                 self->commit_ > self->sent_commit_[p];
+    if (!(first || due || fresh)) {
+      co_await sim->sleep(POLL);
+      continue;
+    }
+    first = false;
+    last_send = sim->now();
+    if (self->next_idx_[p] <= self->snap_last_index_) {
+      // peer is behind our compaction horizon -> InstallSnapshot (raft.rs:159)
+      InstallSnapshotArgs args{term, (uint32_t)self->me_,
+                               self->snap_last_index_, self->snap_last_term_,
+                               self->snap_data_};
+      auto r = co_await sim->call_timeout(peer, args, RPC_TIMEOUT);
+      if (self->role_ != Role::Leader || self->term_ != term) co_return;
+      if (!r) continue;
+      if (r->term > self->term_) {
+        self->step_down(r->term);
+        self->persist();
+        co_return;
+      }
+      self->match_idx_[p] = std::max(self->match_idx_[p], args.last_index);
+      self->next_idx_[p] = std::max(self->next_idx_[p], args.last_index + 1);
+      continue;
+    }
+    AppendEntriesArgs args;
+    args.term = term;
+    args.leader = (uint32_t)self->me_;
+    args.prev_index = self->next_idx_[p] - 1;
+    args.prev_term = self->term_at(args.prev_index);
+    uint64_t from = self->next_idx_[p];
+    uint64_t upto =
+        std::min(self->last_index(), from + (AE_BATCH_MAX - 1));
+    for (uint64_t i = from; i <= upto; i++)
+      args.entries.push_back(self->entry_at(i));
+    args.leader_commit = self->commit_;
+    self->sent_commit_[p] = self->commit_;
+    auto r = co_await sim->call_timeout(peer, args, RPC_TIMEOUT);
+    if (self->role_ != Role::Leader || self->term_ != term) co_return;
+    if (!r) continue;  // lost/timeout: next loop retries (heartbeat due)
+    if (r->term > self->term_) {
+      self->step_down(r->term);
+      self->persist();
+      co_return;
+    }
+    if (r->success) {
+      self->match_idx_[p] = std::max(self->match_idx_[p], r->hint);
+      self->next_idx_[p] = std::max(self->next_idx_[p], r->hint + 1);
+      self->advance_commit();
+    } else {
+      // fast backtrack to the follower's hint; floor at 1 (snapshot case is
+      // handled by the next_idx_ <= snap_last_index_ branch next round)
+      self->next_idx_[p] =
+          std::max<uint64_t>(1, std::min(self->next_idx_[p], r->hint));
+    }
+  }
+}
+
+void Raft::advance_commit() {
+  if (role_ != Role::Leader) return;
+  std::vector<uint64_t> m = match_idx_;
+  m[me_] = last_index();
+  std::sort(m.begin(), m.end());
+  uint64_t majority_match = m[peers_.size() - (peers_.size() / 2 + 1)];
+  // only commit entries from the current term (Raft §5.4.2, Figure 8)
+  if (majority_match > commit_ && majority_match > snap_last_index_ &&
+      term_at(majority_match) == term_) {
+    commit_ = majority_match;
+    apply_committed();
+  }
+}
+
+void Raft::apply_committed() {
+  while (last_applied_ < commit_) {
+    last_applied_++;
+    if (last_applied_ <= snap_last_index_) continue;  // covered by snapshot
+    apply_ch_.send(
+        ApplyMsg{false, entry_at(last_applied_).data, last_applied_, 0});
+  }
+}
+
+// -------------------------------------------------------------- persistence
+
+uint64_t Raft::term_at(uint64_t index) const {
+  if (index == snap_last_index_) return snap_last_term_;
+  if (index == 0) return 0;
+  return log_[index - snap_last_index_ - 1].term;
+}
+
+void Raft::persist() {
+  // "state" = Persist{term, voted_for, snapshot meta, log}; "snapshot" = raw
+  // service bytes. Both synced per write — the file-size contract the testers
+  // assert on (raft.rs:173-211, tester.rs:152-158).
+  Enc e;
+  e.u64(term_);
+  e.u64((uint64_t)(voted_for_ + 1));
+  e.u64(snap_last_index_);
+  e.u64(snap_last_term_);
+  e.u64(log_.size());
+  for (auto& ent : log_) {
+    e.u64(ent.term);
+    e.bytes(ent.data);
+  }
+  sim_->fs_write_at(addr_, "state", std::move(e.out));
+  if (snap_dirty_) {
+    sim_->fs_write_at(addr_, "snapshot", snap_data_);
+    snap_dirty_ = false;
+  }
+}
+
+void Raft::restore() {
+  auto snap = sim_->fs_read_at(addr_, "snapshot");
+  if (snap) snap_data_ = *snap;
+  auto st = sim_->fs_read_at(addr_, "state");
+  if (!st) return;  // first boot (NotFound, raft.rs:195-209)
+  Dec d(*st);
+  term_ = d.u64();
+  voted_for_ = (int)d.u64() - 1;
+  snap_last_index_ = d.u64();
+  snap_last_term_ = d.u64();
+  uint64_t n = d.u64();
+  log_.clear();
+  for (uint64_t i = 0; i < n; i++) {
+    LogEntry ent;
+    ent.term = d.u64();
+    ent.data = d.bytes();
+    log_.push_back(std::move(ent));
+  }
+}
+
+}  // namespace raftcore

@@ -1,0 +1,204 @@
+// raft-core — a complete Raft consensus implementation on the simcore
+// deterministic runtime. This fills in what the reference leaves as todo!()
+// stubs while matching its public API surface (SURVEY.md §2 C2-C4):
+//
+//   RaftHandle::new(peers, me) -> (handle, apply channel)
+//     -> Raft::boot(sim, peers, me, apply_ch)    (/root/reference/src/raft/raft.rs:108)
+//   start(&[u8]) -> Result<Start{index,term}, NotLeader(hint)>
+//     -> Raft::start(Bytes) -> StartResult        (raft.rs:131, raft.rs:40-53)
+//   term() / is_leader()                          (raft.rs:138,144)
+//   snapshot(index, &[u8])                        (raft.rs:166)
+//   cond_install_snapshot(term, index, &[u8])     (raft.rs:153)
+//   ApplyMsg::{Command, Snapshot}                 (raft.rs:26-37)
+//   persistence files "state"/"snapshot"          (raft.rs:173-211)
+//
+// Design notes (deliberately not a port):
+//  * simcore is single-threaded, so there are no locks; every mutation runs
+//    to completion between awaits.
+//  * Persistence (fs_write) is synchronous in-sim, which gives the
+//    "persist before reply/send" ordering of the reference (raft.rs:224-233)
+//    simply by calling persist() before any co_return / RPC send.
+//  * Replication uses one long-lived coroutine per (leader-term, peer) that
+//    sends when there is new data (entries or commit) or a heartbeat is due,
+//    otherwise polls virtual time; polling costs nothing in a discrete-event
+//    simulator and keeps RPC counts within the reference budgets
+//    (/root/reference/src/raft/tests.rs:389-479).
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <vector>
+
+#include "../simcore/simcore.h"
+#include "codec.h"
+
+namespace raftcore {
+
+using simcore::Addr;
+using simcore::Channel;
+using simcore::Sim;
+using simcore::Task;
+using simcore::MSEC;
+
+struct LogEntry {
+  uint64_t term;
+  Bytes data;
+};
+
+// raft.rs:26-37
+struct ApplyMsg {
+  bool is_snapshot;
+  Bytes data;
+  uint64_t index;  // command index, or snapshot last-included index
+  uint64_t term;   // snapshot only
+};
+
+// raft.rs:40-53: Start{index,term} or NotLeader(hint)
+struct StartResult {
+  bool ok;
+  uint64_t index = 0;
+  uint64_t term = 0;
+  int hint = -1;  // last observed leader id; -1 unknown
+};
+
+struct RequestVoteReply {
+  uint64_t term;
+  bool granted;
+};
+struct RequestVoteArgs {
+  uint64_t term;
+  uint32_t candidate;  // peer id (index into peers)
+  uint64_t last_log_index;
+  uint64_t last_log_term;
+  using Reply = RequestVoteReply;
+};
+
+struct AppendEntriesReply {
+  uint64_t term;
+  bool success;
+  uint64_t hint;  // on failure: next index the leader should try (fast backtrack)
+};
+struct AppendEntriesArgs {
+  uint64_t term;
+  uint32_t leader;
+  uint64_t prev_index;
+  uint64_t prev_term;
+  std::vector<LogEntry> entries;
+  uint64_t leader_commit;
+  using Reply = AppendEntriesReply;
+};
+
+struct InstallSnapshotReply {
+  uint64_t term;
+};
+struct InstallSnapshotArgs {
+  uint64_t term;
+  uint32_t leader;
+  uint64_t last_index;
+  uint64_t last_term;
+  Bytes data;
+  using Reply = InstallSnapshotReply;
+};
+
+class Raft : public std::enable_shared_from_this<Raft> {
+ public:
+  // Boot a node: restore from its persistent files, register RPC handlers,
+  // start the election ticker. MUST be spawned on peers[me]'s address (the
+  // reference boots via local_handle(addr).spawn(RaftHandle::new),
+  // tester.rs:297-298). If a snapshot was restored, it is delivered first on
+  // the apply channel so the service can reinstall its state.
+  static Task<std::shared_ptr<Raft>> boot(Sim* sim, std::vector<Addr> peers,
+                                          size_t me, Channel<ApplyMsg> apply_ch);
+
+  // Submit a command; leader-only. Appends + persists synchronously; the
+  // replicators pick it up on their next poll (<= POLL virtual time later).
+  StartResult start(Bytes cmd);
+
+  uint64_t term() const { return term_; }
+  bool is_leader() const { return role_ == Role::Leader; }
+  int leader_hint() const { return leader_hint_; }
+
+  // Service-driven log compaction (raft.rs:166): everything <= index is
+  // covered by `data`.
+  void snapshot(uint64_t index, Bytes data);
+
+  // Apply-channel handshake for leader-installed snapshots (raft.rs:153).
+  bool cond_install_snapshot(uint64_t last_term, uint64_t last_index, Bytes data);
+
+  // --- introspection for testers ---
+  uint64_t last_index() const { return snap_last_index_ + log_.size(); }
+  uint64_t commit_index() const { return commit_; }
+
+  // timing constants (virtual ns)
+  static constexpr uint64_t TICK = 10 * MSEC;       // election ticker period
+  static constexpr uint64_t POLL = 5 * MSEC;        // replicator poll period
+  static constexpr uint64_t HEARTBEAT = 100 * MSEC; // idle AE cadence
+  static constexpr uint64_t RPC_TIMEOUT = 100 * MSEC;
+  static constexpr uint64_t ELECTION_MIN = 150 * MSEC;  // raft.rs:262
+  static constexpr uint64_t ELECTION_MAX = 300 * MSEC;
+  static constexpr size_t AE_BATCH_MAX = 128;  // entries per AppendEntries
+
+ private:
+  enum class Role { Follower, Candidate, Leader };
+
+  Raft(Sim* sim, std::vector<Addr> peers, size_t me, Channel<ApplyMsg> ch)
+      : sim_(sim), peers_(std::move(peers)), me_(me), addr_(peers_[me]),
+        apply_ch_(std::move(ch)) {}
+
+  // RPC handlers (synchronous; persist before returning the reply)
+  RequestVoteReply handle_request_vote(const RequestVoteArgs& a);
+  AppendEntriesReply handle_append_entries(const AppendEntriesArgs& a);
+  InstallSnapshotReply handle_install_snapshot(const InstallSnapshotArgs& a);
+
+  // long-lived tasks (spawned on addr_, so Sim::kill crashes them)
+  static Task<void> election_loop(std::shared_ptr<Raft> self);
+  static Task<void> vote_task(std::shared_ptr<Raft> self, Addr peer,
+                              uint64_t term);
+  static Task<void> replicator(std::shared_ptr<Raft> self, size_t peer,
+                               uint64_t term);
+
+  void start_election();
+  void become_leader();
+  void step_down(uint64_t new_term);  // caller persists
+  void reset_election_deadline();
+  void advance_commit();
+  void apply_committed();
+  void register_handlers();
+
+  // log index mapping: log_[k] holds index snap_last_index_ + 1 + k (1-based)
+  uint64_t term_at(uint64_t index) const;
+  const LogEntry& entry_at(uint64_t index) const {
+    return log_[index - snap_last_index_ - 1];
+  }
+
+  void persist();
+  void restore();
+
+  Sim* sim_;
+  std::vector<Addr> peers_;
+  size_t me_;
+  Addr addr_;
+  Channel<ApplyMsg> apply_ch_;
+
+  // persistent (raft.rs:95-98 Persist{term, voted_for, log} + snapshot meta)
+  uint64_t term_ = 0;
+  int voted_for_ = -1;
+  std::vector<LogEntry> log_;
+  uint64_t snap_last_index_ = 0;
+  uint64_t snap_last_term_ = 0;
+  Bytes snap_data_;
+  bool snap_dirty_ = false;  // write the "snapshot" file only when it changed
+
+  // volatile
+  Role role_ = Role::Follower;
+  uint64_t commit_ = 0;
+  uint64_t last_applied_ = 0;
+  uint64_t election_deadline_ = 0;
+  int leader_hint_ = -1;
+  size_t votes_ = 0;
+  std::vector<uint64_t> next_idx_;
+  std::vector<uint64_t> match_idx_;
+  std::vector<uint64_t> sent_commit_;  // commit index last sent to each peer
+};
+
+}  // namespace raftcore

@@ -1,0 +1,299 @@
+// RaftTester — cluster harness + safety/liveness checkers for the Lab 2
+// suite, the C++ analogue of the reference's tester (SURVEY.md §2 C10,
+// /root/reference/src/raft/tester.rs):
+//   * n nodes at addresses 0.0.1.i (tester.rs:46-48)
+//   * per-node applier feeding shared storage with online safety checks
+//     (committed-value agreement + in-order apply, tester.rs:301-326,379-397)
+//   * liveness driver one(cmd, expected, retry) with 10s/2s budgets
+//     (tester.rs:216-262)
+//   * fault verbs: connect/disconnect, crash1 (kill), start1 (restart with
+//     recovery) (tester.rs:264-333)
+//   * unreliable-net toggle: 10% loss, 1-27ms latency (tester.rs:127-137)
+//   * metrics: RPC count = msg_count/2, on-disk log/snapshot size
+//     (tester.rs:147-158)
+//   * SNAPSHOT_INTERVAL=10: applier snapshots every 10th index when enabled
+//     (tester.rs:31,311-313)
+#pragma once
+
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <optional>
+#include <vector>
+
+#include "../tests/framework.h"
+#include "raft.h"
+
+namespace raftcore {
+
+using simcore::SEC;
+using simcore::make_addr;
+
+constexpr uint64_t RAFT_ELECTION_TIMEOUT = 1 * SEC;  // tests.rs:18
+constexpr uint64_t SNAPSHOT_INTERVAL = 10;           // tester.rs:31
+
+class RaftTester {
+ public:
+  RaftTester(Sim* sim, int n, bool unreliable, bool snapshot)
+      : sim_(sim), n_(n), snapshot_(snapshot) {
+    for (int i = 0; i < n; i++) addrs_.push_back(make_addr(0, 0, 1, i + 1));
+    rafts_.resize(n);
+    connected_.assign(n, false);
+    storage_.resize(n);
+    set_unreliable(unreliable);
+    start_time_ = sim->now();
+  }
+
+  Task<void> init() {
+    for (int i = 0; i < n_; i++) {
+      co_await sim_->spawn(start1(i));
+      connect(i);
+    }
+  }
+
+  Sim* sim() { return sim_; }
+  int n() const { return n_; }
+  std::shared_ptr<Raft> raft(int i) { return rafts_[i]; }
+
+  // ---- cluster control (tester.rs:264-333)
+  Task<void> start1(int i) {
+    crash1(i);
+    Channel<ApplyMsg> ch;
+    rafts_[i] = co_await sim_->spawn(addrs_[i],
+                                     Raft::boot(sim_, addrs_, i, ch));
+    sim_->spawn(addrs_[i], applier_task(this, i, ch));
+  }
+  void crash1(int i) {
+    sim_->kill(addrs_[i]);
+    rafts_[i] = nullptr;
+  }
+  void connect(int i) {
+    connected_[i] = true;
+    sim_->connect(addrs_[i]);
+  }
+  void disconnect(int i) {
+    connected_[i] = false;
+    sim_->disconnect(addrs_[i]);
+  }
+  bool is_connected(int i) const { return connected_[i]; }
+
+  void set_unreliable(bool unreliable) {
+    auto& cfg = sim_->net_config();
+    if (unreliable) {
+      cfg.packet_loss_rate = 0.1;
+      cfg.send_latency_min = 1 * MSEC;   // tester.rs:127-137
+      cfg.send_latency_max = 27 * MSEC;
+    } else {
+      cfg.packet_loss_rate = 0.0;
+      cfg.send_latency_min = 1 * MSEC;
+      cfg.send_latency_max = 10 * MSEC;
+    }
+  }
+
+  // ---- metrics (tester.rs:147-158)
+  uint64_t rpcs() const { return sim_->msg_count() / 2; }
+  size_t log_size() const {
+    size_t m = 0;
+    for (auto a : addrs_) m = std::max(m, sim_->fs_size(a, "state"));
+    return m;
+  }
+  size_t snapshot_size() const {
+    size_t m = 0;
+    for (auto a : addrs_) m = std::max(m, sim_->fs_size(a, "snapshot"));
+    return m;
+  }
+
+  // ---- checkers (tester.rs:64-109)
+  Task<int> check_one_leader() {
+    for (int iters = 0; iters < 10; iters++) {
+      co_await sim_->sleep(sim_->rand_range(450, 551) * MSEC);
+      std::map<uint64_t, std::vector<int>> leaders;
+      for (int i = 0; i < n_; i++)
+        if (connected_[i] && rafts_[i] && rafts_[i]->is_leader())
+          leaders[rafts_[i]->term()].push_back(i);
+      for (auto& [term, who] : leaders) {
+        if (who.size() > 1) {
+          std::fprintf(stderr, "term %llu has %zu (>1) leaders\n",
+                       (unsigned long long)term, who.size());
+          std::abort();
+        }
+      }
+      if (!leaders.empty()) co_return leaders.rbegin()->second[0];
+    }
+    std::fprintf(stderr, "expected one leader, got none\n");
+    std::abort();
+  }
+
+  Task<void> check_no_leader() {
+    for (int i = 0; i < n_; i++) {
+      if (connected_[i] && rafts_[i] && rafts_[i]->is_leader()) {
+        std::fprintf(stderr, "expected no leader, but %d claims to be\n", i);
+        std::abort();
+      }
+    }
+    co_return;
+  }
+
+  Task<uint64_t> check_terms() {
+    uint64_t term = 0;
+    for (int i = 0; i < n_; i++) {
+      if (connected_[i] && rafts_[i]) {
+        uint64_t t = rafts_[i]->term();
+        if (term == 0) term = t;
+        else if (term != t) {
+          std::fprintf(stderr, "servers disagree on term\n");
+          std::abort();
+        }
+      }
+    }
+    co_return term;
+  }
+
+  // how many peers have committed (applied) `index`, and the value there
+  std::pair<int, std::optional<uint64_t>> n_committed(uint64_t index) {
+    int count = 0;
+    std::optional<uint64_t> val;
+    for (int i = 0; i < n_; i++) {
+      if (storage_[i].size() >= index) {
+        count++;
+        val = storage_[i][index - 1];  // agreement already checked on apply
+      }
+    }
+    return {count, val};
+  }
+
+  // wait for index to be committed by at least n peers; nullopt if term moved
+  Task<std::optional<uint64_t>> wait(uint64_t index, int n, uint64_t term) {
+    uint64_t to = 10 * MSEC;
+    for (int iters = 0; iters < 30; iters++) {
+      auto [nd, val] = n_committed(index);
+      if (nd >= n) co_return val;
+      co_await sim_->sleep(to);
+      if (to < 1 * SEC) to *= 2;
+      for (int i = 0; i < n_; i++)
+        if (rafts_[i] && rafts_[i]->term() > term) co_return std::nullopt;
+    }
+    auto [nd, val] = n_committed(index);
+    if (nd < n) {
+      std::fprintf(stderr, "only %d decided for index %llu; wanted %d\n", nd,
+                   (unsigned long long)index, n);
+      std::abort();
+    }
+    co_return val;
+  }
+
+  // liveness driver (tester.rs:216-262): submit cmd, require `expected`
+  // servers to commit it; 10s total / 2s per-index budget (virtual time)
+  Task<uint64_t> one(uint64_t cmd, int expected, bool retry) {
+    uint64_t t0 = sim_->now();
+    int probe = 0;
+    while (sim_->now() - t0 < 10 * SEC) {
+      std::optional<uint64_t> index;
+      for (int off = 0; off < n_; off++) {
+        probe = (probe + 1) % n_;
+        if (!connected_[probe] || !rafts_[probe]) continue;
+        auto r = rafts_[probe]->start(enc_u64(cmd));
+        if (r.ok) {
+          index = r.index;
+          break;
+        }
+      }
+      if (index) {
+        uint64_t t1 = sim_->now();
+        while (sim_->now() - t1 < 2 * SEC) {
+          auto [nd, val] = n_committed(*index);
+          if (nd >= expected && val && *val == cmd) co_return *index;
+          co_await sim_->sleep(20 * MSEC);
+        }
+        if (!retry) break;
+      } else {
+        co_await sim_->sleep(50 * MSEC);
+      }
+    }
+    std::fprintf(stderr, "one(%llu) failed to reach agreement\n",
+                 (unsigned long long)cmd);
+    std::abort();
+  }
+
+  // per-test perf summary (tester.rs:339-351)
+  void end() {
+    std::printf("  ... elapsed %.2fs(virt) peers %d rpcs %llu commits %zu\n",
+                (sim_->now() - start_time_) / 1e9, n_,
+                (unsigned long long)rpcs(), max_applied());
+  }
+
+  size_t max_applied() const {
+    size_t m = 0;
+    for (auto& s : storage_) m = std::max(m, s.size());
+    return m;
+  }
+
+ private:
+  // online safety checks, the analogue of StorageHandle::push_and_check
+  // (tester.rs:379-397): committed-value agreement across peers + no gaps
+  void push_and_check(int i, uint64_t index, uint64_t v) {
+    for (int j = 0; j < n_; j++) {
+      if (j != i && storage_[j].size() >= index &&
+          storage_[j][index - 1] != v) {
+        std::fprintf(stderr,
+                     "commit mismatch at index %llu: node %d has %llu, node %d "
+                     "has %llu\n",
+                     (unsigned long long)index, i,
+                     (unsigned long long)v, j,
+                     (unsigned long long)storage_[j][index - 1]);
+        std::abort();
+      }
+    }
+    if (index == storage_[i].size() + 1) {
+      storage_[i].push_back(v);
+    } else if (index <= storage_[i].size()) {
+      // re-apply after restart: must match what was applied before
+      if (storage_[i][index - 1] != v) {
+        std::fprintf(stderr, "node %d re-applied different value at %llu\n", i,
+                     (unsigned long long)index);
+        std::abort();
+      }
+    } else {
+      std::fprintf(stderr, "node %d applied out of order: index %llu, have %zu\n",
+                   i, (unsigned long long)index, storage_[i].size());
+      std::abort();
+    }
+  }
+
+  static Task<void> applier_task(RaftTester* t, int i, Channel<ApplyMsg> ch) {
+    // runs as node i (killed on crash1); mirrors tester.rs:301-326
+    for (;;) {
+      auto m = co_await ch.recv();
+      if (!m) break;
+      if (m->is_snapshot) {
+        if (t->rafts_[i] &&
+            t->rafts_[i]->cond_install_snapshot(m->term, m->index, m->data)) {
+          // snapshot payload = encoded applied-value prefix
+          Dec d(m->data);
+          uint64_t len = d.u64();
+          t->storage_[i].clear();
+          for (uint64_t k = 0; k < len; k++) t->storage_[i].push_back(d.u64());
+        }
+      } else {
+        t->push_and_check(i, m->index, dec_u64(m->data));
+        if (t->snapshot_ && m->index % SNAPSHOT_INTERVAL == 0 && t->rafts_[i]) {
+          Enc e;
+          e.u64(m->index);
+          for (uint64_t k = 0; k < m->index; k++) e.u64(t->storage_[i][k]);
+          t->rafts_[i]->snapshot(m->index, std::move(e.out));
+        }
+      }
+    }
+  }
+
+  Sim* sim_;
+  int n_;
+  bool snapshot_;
+  uint64_t start_time_;
+  std::vector<Addr> addrs_;
+  std::vector<std::shared_ptr<Raft>> rafts_;
+  std::vector<bool> connected_;
+  std::vector<std::vector<uint64_t>> storage_;  // applied values, 1-based index
+};
+
+}  // namespace raftcore

@@ -31,6 +31,8 @@ void Sim::resume_in_context(uint64_t tid, std::coroutine_handle<> h) {
 
 void Sim::task_finished(uint64_t tid) {
   live_.erase(tid);
+  auto it = task_addr_.find(tid);
+  if (it != task_addr_.end()) node_tasks_[it->second].erase(tid);
   finished_.push_back(tid);
 }
 
@@ -49,6 +51,8 @@ void Sim::abort_task(uint64_t tid) {
     it->second.destroy();
     frames_.erase(it);
   }
+  auto at = task_addr_.find(tid);
+  if (at != task_addr_.end()) node_tasks_[at->second].erase(tid);
   task_addr_.erase(tid);
 }
 
@@ -115,8 +119,11 @@ bool Sim::run(Task<void> main) {
     Event ev = std::move(const_cast<Event&>(events_.top()));
     events_.pop();
     now_ = ev.t;
-    // fold the pop into the determinism trace (FNV-1a style)
+    // fold the pop into the determinism trace (FNV-1a style); both timestamp
+    // and sequence number, so even same-timestamp reorderings are caught
     trace_hash_ ^= ev.t + 0x9e3779b97f4a7c15ull + (trace_hash_ << 6);
+    trace_hash_ *= 0x100000001b3ull;
+    trace_hash_ ^= ev.seq + 0x9e3779b97f4a7c15ull + (trace_hash_ << 6);
     trace_hash_ *= 0x100000001b3ull;
     ev.fn();
     for (uint64_t tid : finished_) {
@@ -129,6 +136,7 @@ bool Sim::run(Task<void> main) {
     }
     finished_.clear();
   }
+  if (trace_observer()) trace_observer()(trace_hash_);
   return true;
 }
 

@@ -92,6 +92,12 @@ struct PromiseBase {
   std::shared_ptr<JoinState<T>> js = std::make_shared<JoinState<T>>();
   Sim* sim = nullptr;
   uint64_t task_id = 0;
+  ~PromiseBase() {
+    // A frame destroyed before completion (kill/abort) never runs its
+    // waiters; clear them here to break the JoinState<->waiter-closure
+    // reference cycle (waiters commonly capture a TaskRef that owns js).
+    if (!js->done) js->waiters.clear();
+  }
   std::suspend_always initial_suspend() noexcept { return {}; }
   struct FinalAwaiter {
     bool await_ready() noexcept { return false; }
@@ -293,7 +299,16 @@ class Sim {
     fs_[cur_addr_][name] = std::move(data);
   }
   std::optional<Bytes> fs_read(const std::string& name) {
-    auto& files = fs_[cur_addr_];
+    return fs_read_at(cur_addr_, name);
+  }
+  // addr-explicit variants: node code that runs synchronously from a
+  // tester-context call (e.g. RaftHandle::start persisting before return)
+  // still targets its own node's disk
+  void fs_write_at(Addr node, const std::string& name, Bytes data) {
+    fs_[node][name] = std::move(data);
+  }
+  std::optional<Bytes> fs_read_at(Addr node, const std::string& name) {
+    auto& files = fs_[node];
     auto it = files.find(name);
     if (it == files.end()) return std::nullopt;
     return it->second;
@@ -307,6 +322,13 @@ class Sim {
   // deadlock (no runnable events while main is still pending).
   bool run(Task<void> main);
   uint64_t trace_hash() const { return trace_hash_; }
+  // Observer invoked with the final trace hash at the end of each run();
+  // the test runner uses it for the double-run determinism check
+  // (MADTPU_TEST_CHECK_DETERMINISTIC, reference README.md:81-87).
+  static std::function<void(uint64_t)>& trace_observer() {
+    static std::function<void(uint64_t)> f;
+    return f;
+  }
 
   // ---- internals (used by awaitable/promise glue; not user API)
   void schedule(uint64_t at, std::function<void()> fn);
@@ -358,7 +380,7 @@ class Sim {
   std::unordered_set<uint64_t> live_;
   std::unordered_map<uint64_t, std::coroutine_handle<>> frames_;
   std::unordered_map<uint64_t, Addr> task_addr_;
-  std::map<Addr, std::vector<uint64_t>> node_tasks_;
+  std::map<Addr, std::set<uint64_t>> node_tasks_;  // live tasks per node
   std::vector<uint64_t> finished_;  // destroyed by run loop after each event
   Addr cur_addr_ = 0;
   uint64_t cur_task_ = 0;
@@ -389,7 +411,7 @@ TaskRef<T> Sim::spawn(Addr node, Task<T> t) {
   live_.insert(tid);
   frames_[tid] = h;
   task_addr_[tid] = node;
-  node_tasks_[node].push_back(tid);
+  node_tasks_[node].insert(tid);
   schedule(now_, [this, tid, h] {
     if (!task_live(tid)) return;
     resume_in_context(tid, h);

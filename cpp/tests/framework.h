@@ -19,7 +19,6 @@ namespace mtest {
 struct TestCase {
   const char* name;
   void (*fn)(uint64_t seed);
-  uint64_t trace_hash;  // set by the runner when determinism-checking
 };
 
 inline std::vector<TestCase>& registry() {
@@ -29,7 +28,7 @@ inline std::vector<TestCase>& registry() {
 
 struct Register {
   Register(const char* name, void (*fn)(uint64_t)) {
-    registry().push_back({name, fn, 0});
+    registry().push_back({name, fn});
   }
 };
 

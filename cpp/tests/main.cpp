@@ -1,12 +1,28 @@
 // Test runner: ./madtpu_tests [--list | test_name ...]; no args = run all.
-// Env: MADTPU_TEST_SEED (replay), MADTPU_TEST_NUM (reruns with fresh seeds),
-// MADTPU_TEST_CHECK_DETERMINISTIC=1 (double-run; relies on each test
-// creating one simcore::Sim and the runner comparing its trace hash —
-// the analogue of the reference's double-run determinism check).
+// Env (the reference's MADSIM_* contract, README.md:42-87):
+//   MADTPU_TEST_SEED   — fixed seed for exact replay
+//   MADTPU_TEST_NUM    — rerun each test N times with fresh seeds
+//   MADTPU_TEST_CHECK_DETERMINISTIC=1 — run each test twice with the same
+//     seed and compare the accumulated simulator trace hashes; any
+//     schedule-dependent behavior fails loudly.
 #include <chrono>
 #include <cstring>
 
+#include "../simcore/simcore.h"
 #include "framework.h"
+
+namespace {
+uint64_t g_hash_acc = 0;
+
+void run_once(const mtest::TestCase& t, uint64_t s) {
+  std::printf("[ RUN  ] %s  MADTPU_TEST_SEED=%llu\n", t.name,
+              (unsigned long long)s);
+  std::fflush(stdout);
+  t.fn(s);
+  std::printf("[ OK   ] %s\n", t.name);
+  std::fflush(stdout);
+}
+}  // namespace
 
 int main(int argc, char** argv) {
   auto& tests = mtest::registry();
@@ -22,6 +38,13 @@ int main(int argc, char** argv) {
     seed = (uint64_t)std::chrono::steady_clock::now().time_since_epoch().count();
   int reruns = 1;
   if (const char* n = std::getenv("MADTPU_TEST_NUM")) reruns = std::atoi(n);
+  const char* det_env = std::getenv("MADTPU_TEST_CHECK_DETERMINISTIC");
+  bool check_det = det_env && det_env[0] && det_env[0] != '0';
+  if (check_det)
+    simcore::Sim::trace_observer() = [](uint64_t h) {
+      g_hash_acc ^= h + 0x9e3779b97f4a7c15ull + (g_hash_acc << 6);
+      g_hash_acc *= 0x100000001b3ull;
+    };
 
   int ran = 0;
   for (auto& t : tests) {
@@ -31,12 +54,22 @@ int main(int argc, char** argv) {
     if (!selected) continue;
     for (int r = 0; r < reruns; r++) {
       uint64_t s = seed + r;
-      std::printf("[ RUN  ] %s  MADTPU_TEST_SEED=%llu\n", t.name,
-                  (unsigned long long)s);
-      std::fflush(stdout);
-      t.fn(s);
-      std::printf("[ OK   ] %s\n", t.name);
-      std::fflush(stdout);
+      if (check_det) {
+        g_hash_acc = 0;
+        run_once(t, s);
+        uint64_t h1 = g_hash_acc;
+        g_hash_acc = 0;
+        run_once(t, s);
+        if (g_hash_acc != h1) {
+          std::fprintf(stderr,
+                       "[ DET! ] %s: two runs with seed %llu produced "
+                       "different event traces\n",
+                       t.name, (unsigned long long)s);
+          return 3;
+        }
+      } else {
+        run_once(t, s);
+      }
     }
     ran++;
   }

@@ -1,0 +1,642 @@
+// Lab 2 (Raft) suite — the 23 tests of the reference spec (SURVEY.md §4.1,
+// /root/reference/src/raft/tests.rs) re-expressed against raft-core on
+// simcore, plus a whole-scenario determinism check. Each test is a function
+// of the seed; failures replay with MADTPU_TEST_SEED=<n>.
+#include "../raftcore/raft_tester.h"
+#include "framework.h"
+
+using namespace raftcore;
+using simcore::Sim;
+
+namespace {
+
+using TestBody = Task<void> (*)(RaftTester&);
+
+Task<void> test_main(Sim* s, RaftTester* t, TestBody body) {
+  co_await s->spawn(t->init());
+  co_await s->spawn(body(*t));
+  t->end();
+}
+
+void run_test(uint64_t seed, int n, bool unreliable, bool snapshot,
+              TestBody body) {
+  Sim sim(seed);
+  RaftTester t(&sim, n, unreliable, snapshot);
+  MT_ASSERT(sim.run(test_main(&sim, &t, body)));
+}
+
+uint64_t rnd(RaftTester& t) { return t.sim()->rand_u64() % 1000000000; }
+
+constexpr size_t MAX_LOG_SIZE = 2000;  // tests.rs:859
+constexpr uint64_t ANY_TERM = ~0ull;   // wait(): don't abort on term change
+
+#define AW(expr) (co_await t.sim()->spawn((expr)))
+#define TSLEEP(ns) co_await t.sim()->sleep(ns)
+
+// ------------------------------------------------------------------ 2A
+
+Task<void> b_initial_election(RaftTester& t) {
+  AW(t.check_one_leader());
+  TSLEEP(50 * MSEC);
+  uint64_t term1 = AW(t.check_terms());
+  MT_ASSERT(term1 >= 1);
+  TSLEEP(2 * RAFT_ELECTION_TIMEOUT);
+  AW(t.check_terms());  // term may not change, but must agree
+  AW(t.check_one_leader());
+}
+MT_TEST(initial_election_2a) { run_test(seed, 3, false, false, b_initial_election); }
+
+Task<void> b_reelection(RaftTester& t) {
+  int leader1 = AW(t.check_one_leader());
+  // leader disconnects: a new one appears
+  t.disconnect(leader1);
+  AW(t.check_one_leader());
+  // old leader rejoins: doesn't disturb the new leader
+  t.connect(leader1);
+  int leader2 = AW(t.check_one_leader());
+  // no quorum: no leader
+  t.disconnect(leader2);
+  t.disconnect((leader2 + 1) % 3);
+  TSLEEP(2 * RAFT_ELECTION_TIMEOUT);
+  AW(t.check_no_leader());
+  // quorum restored
+  t.connect((leader2 + 1) % 3);
+  AW(t.check_one_leader());
+  t.connect(leader2);
+  AW(t.check_one_leader());
+}
+MT_TEST(reelection_2a) { run_test(seed, 3, false, false, b_reelection); }
+
+Task<void> b_many_election(RaftTester& t) {
+  AW(t.check_one_leader());
+  for (int iters = 0; iters < 10; iters++) {
+    int i1 = (int)(t.sim()->rand_u64() % 7);
+    int i2 = (int)(t.sim()->rand_u64() % 7);
+    int i3 = (int)(t.sim()->rand_u64() % 7);
+    t.disconnect(i1);
+    t.disconnect(i2);
+    t.disconnect(i3);
+    AW(t.check_one_leader());  // 4+ nodes remain: a leader must exist
+    t.connect(i1);
+    t.connect(i2);
+    t.connect(i3);
+  }
+  AW(t.check_one_leader());
+}
+MT_TEST(many_election_2a) { run_test(seed, 7, false, false, b_many_election); }
+
+// ------------------------------------------------------------------ 2B
+
+Task<void> b_basic_agree(RaftTester& t) {
+  for (uint64_t index = 1; index <= 3; index++) {
+    auto [nd, val] = t.n_committed(index);
+    MT_ASSERT_EQ(nd, 0);  // nothing committed yet
+    uint64_t xindex = AW(t.one(index * 100, 5, false));
+    MT_ASSERT_EQ(xindex, index);
+  }
+}
+MT_TEST(basic_agree_2b) { run_test(seed, 5, false, false, b_basic_agree); }
+
+Task<void> b_fail_agree(RaftTester& t) {
+  AW(t.one(101, 3, false));
+  // follower disconnects: progress with the remaining pair
+  int leader = AW(t.check_one_leader());
+  t.disconnect((leader + 1) % 3);
+  AW(t.one(102, 2, false));
+  AW(t.one(103, 2, false));
+  TSLEEP(RAFT_ELECTION_TIMEOUT);
+  AW(t.one(104, 2, false));
+  AW(t.one(105, 2, false));
+  // rejoin: it catches up
+  t.connect((leader + 1) % 3);
+  AW(t.one(106, 3, true));
+  TSLEEP(RAFT_ELECTION_TIMEOUT);
+  AW(t.one(107, 3, true));
+}
+MT_TEST(fail_agree_2b) { run_test(seed, 3, false, false, b_fail_agree); }
+
+Task<void> b_fail_no_agree(RaftTester& t) {
+  AW(t.one(10, 5, false));
+  // 3 of 5 disconnect: no commit possible
+  int leader = AW(t.check_one_leader());
+  t.disconnect((leader + 1) % 5);
+  t.disconnect((leader + 2) % 5);
+  t.disconnect((leader + 3) % 5);
+  auto r = t.raft(leader)->start(enc_u64(20));
+  MT_ASSERT(r.ok);
+  MT_ASSERT_EQ(r.index, 2u);
+  TSLEEP(2 * RAFT_ELECTION_TIMEOUT);
+  auto [nd, val] = t.n_committed(r.index);
+  MT_ASSERT_EQ(nd, 0);  // no commit without a majority
+  // heal; the index may be reused by the new leader
+  t.connect((leader + 1) % 5);
+  t.connect((leader + 2) % 5);
+  t.connect((leader + 3) % 5);
+  int leader2 = AW(t.check_one_leader());
+  auto r2 = t.raft(leader2)->start(enc_u64(30));
+  MT_ASSERT(r2.ok);
+  MT_ASSERT(r2.index >= 2 && r2.index <= 3);
+  AW(t.one(1000, 5, true));
+}
+MT_TEST(fail_no_agree_2b) { run_test(seed, 5, false, false, b_fail_no_agree); }
+
+Task<void> b_concurrent_starts(RaftTester& t) {
+  bool success = false;
+  for (int try_ = 0; try_ < 5 && !success; try_++) {
+    if (try_ > 0) TSLEEP(3 * SEC);
+    int leader = AW(t.check_one_leader());
+    auto r = t.raft(leader)->start(enc_u64(1));
+    if (!r.ok) continue;  // leader moved on
+    uint64_t term = r.term;
+    std::vector<uint64_t> indices;
+    bool failed = false;
+    for (uint64_t i = 0; i < 5; i++) {  // 5 simultaneous start()s
+      auto ri = t.raft(leader)->start(enc_u64(100 + i));
+      if (!ri.ok || ri.term != term) {
+        failed = true;
+        break;
+      }
+      indices.push_back(ri.index);
+    }
+    if (failed) continue;
+    std::vector<uint64_t> cmds;
+    for (uint64_t idx : indices) {
+      auto v = AW(t.wait(idx, 3, term));
+      if (!v) {
+        failed = true;  // term changed mid-agreement: retry whole round
+        break;
+      }
+      cmds.push_back(*v);
+    }
+    if (failed) continue;
+    for (uint64_t i = 0; i < 5; i++) {
+      bool found = false;
+      for (uint64_t c : cmds)
+        if (c == 100 + i) found = true;
+      MT_ASSERT(found);  // every concurrent start committed, in this term
+    }
+    success = true;
+  }
+  MT_ASSERT(success);
+}
+MT_TEST(concurrent_starts_2b) { run_test(seed, 3, false, false, b_concurrent_starts); }
+
+Task<void> b_rejoin(RaftTester& t) {
+  AW(t.one(101, 3, true));
+  // leader goes into a minority with uncommitted entries
+  int leader1 = AW(t.check_one_leader());
+  t.disconnect(leader1);
+  t.raft(leader1)->start(enc_u64(102));
+  t.raft(leader1)->start(enc_u64(103));
+  t.raft(leader1)->start(enc_u64(104));
+  // new leader commits at index 2
+  AW(t.one(103, 2, true));
+  // new leader into a minority; old leader rejoins and is overwritten
+  int leader2 = AW(t.check_one_leader());
+  t.disconnect(leader2);
+  t.connect(leader1);
+  AW(t.one(104, 2, true));
+  t.connect(leader2);
+  AW(t.one(105, 3, true));
+}
+MT_TEST(rejoin_2b) { run_test(seed, 3, false, false, b_rejoin); }
+
+Task<void> b_backup(RaftTester& t) {
+  AW(t.one(rnd(t), 5, true));
+  // leader + one follower isolated with a pile of uncommitted entries
+  int leader1 = AW(t.check_one_leader());
+  t.disconnect((leader1 + 2) % 5);
+  t.disconnect((leader1 + 3) % 5);
+  t.disconnect((leader1 + 4) % 5);
+  for (int i = 0; i < 50; i++) t.raft(leader1)->start(enc_u64(rnd(t)));
+  TSLEEP(RAFT_ELECTION_TIMEOUT / 2);
+  t.disconnect((leader1 + 0) % 5);
+  t.disconnect((leader1 + 1) % 5);
+  // the other trio commits 50
+  t.connect((leader1 + 2) % 5);
+  t.connect((leader1 + 3) % 5);
+  t.connect((leader1 + 4) % 5);
+  for (int i = 0; i < 50; i++) AW(t.one(rnd(t), 3, true));
+  // new leader + one follower isolated with uncommitted entries
+  int leader2 = AW(t.check_one_leader());
+  int other = (leader1 + 2) % 5;
+  if (leader2 == other) other = (leader2 + 1) % 5;
+  t.disconnect(other);
+  for (int i = 0; i < 50; i++) t.raft(leader2)->start(enc_u64(rnd(t)));
+  TSLEEP(RAFT_ELECTION_TIMEOUT / 2);
+  // bring the original pair + `other` back: they must reconcile fast
+  for (int i = 0; i < 5; i++) t.disconnect(i);
+  t.connect((leader1 + 0) % 5);
+  t.connect((leader1 + 1) % 5);
+  t.connect(other);
+  for (int i = 0; i < 50; i++) AW(t.one(rnd(t), 3, true));
+  for (int i = 0; i < 5; i++) t.connect(i);
+  AW(t.one(rnd(t), 5, true));
+}
+MT_TEST(backup_2b) { run_test(seed, 5, false, false, b_backup); }
+
+Task<void> b_count(RaftTester& t) {
+  // election budget (tests.rs:397-401)
+  AW(t.check_one_leader());
+  uint64_t total1 = t.rpcs();
+  MT_ASSERT(total1 >= 1 && total1 <= 30);
+
+  const uint64_t iters = 10;
+  bool success = false;
+  for (int try_ = 0; try_ < 5 && !success; try_++) {
+    if (try_ > 0) TSLEEP(3 * SEC);
+    int leader = AW(t.check_one_leader());
+    uint64_t before = t.rpcs();
+    auto r = t.raft(leader)->start(enc_u64(1));
+    if (!r.ok) continue;
+    std::vector<uint64_t> cmds;
+    bool failed = false;
+    for (uint64_t i = 1; i <= iters; i++) {
+      uint64_t x = t.sim()->rand_u64() % 1000000;
+      cmds.push_back(x);
+      auto ri = t.raft(leader)->start(enc_u64(x));
+      if (!ri.ok || ri.term != r.term) {
+        failed = true;
+        break;
+      }
+      MT_ASSERT_EQ(ri.index, r.index + i);
+    }
+    if (failed) continue;
+    for (uint64_t i = 1; i <= iters; i++) {
+      auto v = AW(t.wait(r.index + i, 3, r.term));
+      if (!v) {
+        failed = true;
+        break;
+      }
+      MT_ASSERT_EQ(*v, cmds[i - 1]);
+    }
+    if (failed) continue;
+    // agreement budget (tests.rs:461-462)
+    uint64_t total2 = t.rpcs() - before;
+    MT_ASSERT(total2 <= (iters + 1 + 3) * 3);
+    success = true;
+  }
+  MT_ASSERT(success);
+  // idle budget (tests.rs:470-476)
+  TSLEEP(1 * SEC);
+  uint64_t total3 = t.rpcs();
+  TSLEEP(1 * SEC);
+  MT_ASSERT(t.rpcs() - total3 <= 3 * 20);
+}
+MT_TEST(count_2b) { run_test(seed, 3, false, false, b_count); }
+
+// ------------------------------------------------------------------ 2C
+
+Task<void> b_persist1(RaftTester& t) {
+  AW(t.one(11, 3, true));
+  // crash+restart everyone
+  for (int i = 0; i < 3; i++) t.crash1(i);
+  for (int i = 0; i < 3; i++) {
+    AW(t.start1(i));
+    t.connect(i);
+  }
+  AW(t.one(12, 3, true));
+  int leader1 = AW(t.check_one_leader());
+  t.disconnect(leader1);
+  t.crash1(leader1);
+  AW(t.start1(leader1));
+  t.connect(leader1);
+  AW(t.one(13, 3, true));
+  int leader2 = AW(t.check_one_leader());
+  t.crash1(leader2);
+  AW(t.one(14, 2, true));
+  AW(t.start1(leader2));
+  t.connect(leader2);
+  AW(t.wait(4, 3, ANY_TERM));  // restarted leader catches up
+  int i3 = (AW(t.check_one_leader()) + 1) % 3;
+  t.crash1(i3);
+  AW(t.one(15, 2, true));
+  AW(t.start1(i3));
+  t.connect(i3);
+  AW(t.one(16, 3, true));
+}
+MT_TEST(persist1_2c) { run_test(seed, 3, false, false, b_persist1); }
+
+Task<void> b_persist2(RaftTester& t) {
+  uint64_t index = 1;
+  for (int iters = 0; iters < 5; iters++) {
+    AW(t.one(10 + index, 5, true));
+    index++;
+    int leader1 = AW(t.check_one_leader());
+    t.crash1((leader1 + 1) % 5);
+    t.crash1((leader1 + 2) % 5);
+    AW(t.one(10 + index, 3, true));
+    index++;
+    t.crash1((leader1 + 0) % 5);
+    t.crash1((leader1 + 3) % 5);
+    t.crash1((leader1 + 4) % 5);
+    AW(t.start1((leader1 + 1) % 5));
+    t.connect((leader1 + 1) % 5);
+    AW(t.start1((leader1 + 2) % 5));
+    t.connect((leader1 + 2) % 5);
+    TSLEEP(RAFT_ELECTION_TIMEOUT);
+    AW(t.start1((leader1 + 3) % 5));
+    t.connect((leader1 + 3) % 5);
+    AW(t.one(10 + index, 3, true));
+    index++;
+    AW(t.start1((leader1 + 4) % 5));
+    t.connect((leader1 + 4) % 5);
+    AW(t.start1((leader1 + 0) % 5));
+    t.connect((leader1 + 0) % 5);
+  }
+  AW(t.one(1000, 5, true));
+}
+MT_TEST(persist2_2c) { run_test(seed, 5, false, false, b_persist2); }
+
+Task<void> b_persist3(RaftTester& t) {
+  AW(t.one(101, 3, true));
+  int leader = AW(t.check_one_leader());
+  t.disconnect((leader + 2) % 3);
+  AW(t.one(102, 2, true));
+  // crash both members of the pair that made progress
+  t.crash1((leader + 0) % 3);
+  t.crash1((leader + 1) % 3);
+  t.connect((leader + 2) % 3);
+  AW(t.start1((leader + 0) % 3));
+  t.connect((leader + 0) % 3);
+  AW(t.one(103, 2, true));
+  AW(t.start1((leader + 1) % 3));
+  t.connect((leader + 1) % 3);
+  AW(t.one(104, 3, true));
+}
+MT_TEST(persist3_2c) { run_test(seed, 3, false, false, b_persist3); }
+
+Task<void> b_figure8(RaftTester& t) {
+  // Raft Figure 8: repeatedly crash leaders with in-flight entries; no
+  // committed entry may ever be lost (tests.rs:612-660).
+  AW(t.one(rnd(t), 1, true));
+  int nup = 5;
+  for (int iters = 0; iters < 1000; iters++) {
+    int leader = -1;
+    for (int i = 0; i < 5; i++) {
+      if (t.raft(i)) {
+        auto r = t.raft(i)->start(enc_u64(rnd(t)));
+        if (r.ok) leader = i;
+      }
+    }
+    if (t.sim()->rand_u64() % 1000 < 100)
+      TSLEEP(t.sim()->rand_u64() % (RAFT_ELECTION_TIMEOUT / 2));
+    else
+      TSLEEP(t.sim()->rand_u64() % (13 * MSEC));
+    if (leader != -1) {
+      t.crash1(leader);
+      nup--;
+    }
+    if (nup < 3) {
+      int s = (int)(t.sim()->rand_u64() % 5);
+      if (!t.raft(s)) {
+        AW(t.start1(s));
+        t.connect(s);
+        nup++;
+      }
+    }
+  }
+  for (int i = 0; i < 5; i++) {
+    if (!t.raft(i)) {
+      AW(t.start1(i));
+      t.connect(i);
+    }
+  }
+  AW(t.one(rnd(t), 5, true));
+}
+MT_TEST(figure_8_2c) { run_test(seed, 5, false, false, b_figure8); }
+
+Task<void> b_unreliable_agree(RaftTester& t) {
+  std::vector<simcore::TaskRef<uint64_t>> refs;
+  for (uint64_t iters = 1; iters < 50; iters++) {
+    for (uint64_t j = 0; j < 4; j++)
+      refs.push_back(t.sim()->spawn(t.one(100 * iters + j, 1, true)));
+    AW(t.one(iters, 1, true));
+  }
+  for (auto& r : refs) co_await r;
+  t.set_unreliable(false);
+  TSLEEP(RAFT_ELECTION_TIMEOUT);
+  AW(t.one(100, 5, true));
+}
+MT_TEST(unreliable_agree_2c) { run_test(seed, 5, true, false, b_unreliable_agree); }
+
+Task<void> b_figure8_unreliable(RaftTester& t) {
+  AW(t.one(rnd(t) % 10000, 1, true));
+  int nup = 5;
+  for (int iters = 0; iters < 1000; iters++) {
+    if (iters == 200) {
+      // crank up delay variance mid-run (the reference enables long
+      // reordering here, tests.rs:689)
+      t.sim()->net_config().send_latency_max = 60 * MSEC;
+    }
+    int leader = -1;
+    for (int i = 0; i < 5; i++) {
+      auto r = t.raft(i)->start(enc_u64(rnd(t) % 10000));
+      if (r.ok && t.is_connected(i)) leader = i;
+    }
+    if (t.sim()->rand_u64() % 1000 < 100)
+      TSLEEP(t.sim()->rand_u64() % (RAFT_ELECTION_TIMEOUT / 2));
+    else
+      TSLEEP(t.sim()->rand_u64() % (13 * MSEC));
+    if (leader != -1 && t.sim()->rand_u64() % 1000 < 500) {
+      t.disconnect(leader);
+      nup--;
+    }
+    if (nup < 3) {
+      int s = (int)(t.sim()->rand_u64() % 5);
+      if (!t.is_connected(s)) {
+        t.connect(s);
+        nup++;
+      }
+    }
+  }
+  for (int i = 0; i < 5; i++) t.connect(i);
+  AW(t.one(rnd(t) % 10000, 5, true));
+}
+MT_TEST(figure_8_unreliable_2c) { run_test(seed, 5, true, false, b_figure8_unreliable); }
+
+// churn: concurrent clients race random crash/restart/disconnect storms;
+// every value a client observed as committed must be in the final log
+// (tests.rs:744-856)
+struct ChurnShared {
+  bool stop = false;
+  std::vector<uint64_t> values[3];
+};
+
+Task<void> churn_client(RaftTester* t, int me, std::shared_ptr<ChurnShared> sh) {
+  while (!sh->stop) {
+    uint64_t x = t->sim()->rand_u64();
+    int start_i = (int)(t->sim()->rand_u64() % t->n());
+    std::optional<uint64_t> index;
+    for (int off = 0; off < t->n(); off++) {
+      int i = (start_i + off) % t->n();
+      if (!t->raft(i)) continue;
+      auto r = t->raft(i)->start(enc_u64(x));
+      if (r.ok) {
+        index = r.index;
+        break;
+      }
+    }
+    if (index) {
+      for (uint64_t to = 10 * MSEC; to <= 320 * MSEC; to *= 2) {
+        auto [nd, val] = t->n_committed(*index);
+        if (nd > 0) {
+          if (val && *val == x) sh->values[me].push_back(x);
+          break;
+        }
+        co_await t->sim()->sleep(to);
+      }
+    } else {
+      co_await t->sim()->sleep((79 + me * 17) * MSEC);
+    }
+  }
+}
+
+Task<void> b_churn(RaftTester& t) {
+  AW(t.one(rnd(t), 1, true));
+  auto sh = std::make_shared<ChurnShared>();
+  std::vector<simcore::TaskRef<void>> clients;
+  for (int me = 0; me < 3; me++)
+    clients.push_back(
+        t.sim()->spawn(make_addr(0, 0, 2, me + 1), churn_client(&t, me, sh)));
+  for (int iters = 0; iters < 20; iters++) {
+    if (t.sim()->rand_u64() % 1000 < 200) {
+      int i = (int)(t.sim()->rand_u64() % 5);
+      t.disconnect(i);
+    }
+    if (t.sim()->rand_u64() % 1000 < 500) {
+      int i = (int)(t.sim()->rand_u64() % 5);
+      if (!t.raft(i)) AW(t.start1(i));
+      t.connect(i);
+    }
+    if (t.sim()->rand_u64() % 1000 < 200) {
+      int i = (int)(t.sim()->rand_u64() % 5);
+      if (t.raft(i)) t.crash1(i);
+    }
+    TSLEEP(RAFT_ELECTION_TIMEOUT * 7 / 10);
+  }
+  TSLEEP(RAFT_ELECTION_TIMEOUT);
+  t.set_unreliable(false);
+  for (int i = 0; i < 5; i++) {
+    if (!t.raft(i)) AW(t.start1(i));
+    t.connect(i);
+  }
+  sh->stop = true;
+  for (auto& c : clients) co_await c;
+  uint64_t last_index = AW(t.one(rnd(t), 5, true));
+  // collect the final committed log and verify every client-observed commit
+  std::vector<uint64_t> really;
+  for (uint64_t idx = 1; idx <= last_index; idx++) {
+    auto [nd, val] = t.n_committed(idx);
+    MT_ASSERT(nd > 0);
+    really.push_back(*val);
+  }
+  for (int me = 0; me < 3; me++) {
+    for (uint64_t v : sh->values[me]) {
+      bool found = false;
+      for (uint64_t rv : really)
+        if (rv == v) found = true;
+      MT_ASSERT(found);  // an observed commit vanished
+    }
+  }
+}
+MT_TEST(reliable_churn_2c) { run_test(seed, 5, false, false, b_churn); }
+MT_TEST(unreliable_churn_2c) { run_test(seed, 5, true, false, b_churn); }
+
+// ------------------------------------------------------------------ 2D
+
+Task<void> snap_common(RaftTester& t, bool disconnect_, bool reliable,
+                       bool crash) {
+  const int servers = 3;
+  t.set_unreliable(!reliable);
+  AW(t.one(rnd(t), servers, true));
+  int leader1 = AW(t.check_one_leader());
+  for (int i = 0; i < 30; i++) {
+    int victim = (leader1 + 1) % servers;
+    int sender = leader1;
+    if (i % 3 == 1) {
+      sender = (leader1 + 1) % servers;
+      victim = leader1;
+    }
+    if (disconnect_) {
+      t.disconnect(victim);
+      AW(t.one(rnd(t), servers - 1, true));
+    }
+    if (crash) {
+      t.crash1(victim);
+      AW(t.one(rnd(t), servers - 1, true));
+    }
+    // push enough entries that a snapshot must happen while victim is away
+    int nn = (int)(SNAPSHOT_INTERVAL / 2 + t.sim()->rand_u64() % SNAPSHOT_INTERVAL);
+    for (int j = 0; j < nn; j++)
+      if (t.raft(sender)) t.raft(sender)->start(enc_u64(rnd(t)));
+    if (disconnect_ || crash)
+      AW(t.one(rnd(t), servers - 1, true));
+    else
+      AW(t.one(rnd(t), servers, true));
+    MT_ASSERT(t.log_size() < MAX_LOG_SIZE);  // compaction is working
+    if (disconnect_) {
+      // reconnect: catch-up must go through InstallSnapshot
+      t.connect(victim);
+      AW(t.one(rnd(t), servers, true));
+      leader1 = AW(t.check_one_leader());
+    }
+    if (crash) {
+      AW(t.start1(victim));
+      t.connect(victim);
+      AW(t.one(rnd(t), servers, true));
+      leader1 = AW(t.check_one_leader());
+    }
+  }
+}
+
+Task<void> b_snap_basic(RaftTester& t) { co_await t.sim()->spawn(snap_common(t, false, true, false)); }
+Task<void> b_snap_install(RaftTester& t) { co_await t.sim()->spawn(snap_common(t, true, true, false)); }
+Task<void> b_snap_install_unreliable(RaftTester& t) { co_await t.sim()->spawn(snap_common(t, true, false, false)); }
+Task<void> b_snap_install_crash(RaftTester& t) { co_await t.sim()->spawn(snap_common(t, false, true, true)); }
+Task<void> b_snap_install_unreliable_crash(RaftTester& t) { co_await t.sim()->spawn(snap_common(t, false, false, true)); }
+
+MT_TEST(snapshot_basic_2d) { run_test(seed, 3, false, true, b_snap_basic); }
+MT_TEST(snapshot_install_2d) { run_test(seed, 3, false, true, b_snap_install); }
+MT_TEST(snapshot_install_unreliable_2d) {
+  run_test(seed, 3, true, true, b_snap_install_unreliable);
+}
+MT_TEST(snapshot_install_crash_2d) { run_test(seed, 3, false, true, b_snap_install_crash); }
+MT_TEST(snapshot_install_unreliable_crash_2d) {
+  run_test(seed, 3, true, true, b_snap_install_unreliable_crash);
+}
+
+// ---------------------------------------------------- determinism (ours)
+// A full faulty scenario run twice from one seed must produce the identical
+// event trace — the MADTPU_TEST_CHECK_DETERMINISTIC foundation
+// (reference README.md:81-87).
+
+Task<void> b_det_scenario(RaftTester& t) {
+  AW(t.one(1, 3, true));
+  int leader = AW(t.check_one_leader());
+  t.disconnect((leader + 1) % 3);
+  AW(t.one(2, 2, true));
+  t.connect((leader + 1) % 3);
+  t.crash1(leader);
+  AW(t.start1(leader));
+  t.connect(leader);
+  AW(t.one(3, 3, true));
+}
+
+static std::pair<uint64_t, uint64_t> det_run(uint64_t seed) {
+  Sim sim(seed);
+  RaftTester t(&sim, 3, true, false);
+  MT_ASSERT(sim.run(test_main(&sim, &t, b_det_scenario)));
+  return {sim.trace_hash(), sim.msg_count()};
+}
+
+MT_TEST(raft_determinism) {
+  auto a = det_run(seed);
+  auto b = det_run(seed);
+  auto c = det_run(seed + 1);
+  MT_ASSERT_EQ(a.first, b.first);
+  MT_ASSERT_EQ(a.second, b.second);
+  MT_ASSERT(a.first != c.first);
+}
+
+}  // namespace
