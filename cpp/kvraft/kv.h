@@ -1,0 +1,98 @@
+// kv — the Lab 3 key/value service on the generic RSM layer (SURVEY.md §2 C7):
+//   Op::{Get{key}, Put{key,value}, Append{key,value}}
+//                               (/root/reference/src/kvraft/msg.rs:3-8)
+//   Kv state machine, Output = String  (/root/reference/src/kvraft/server.rs:73-87)
+//   Clerk verbs get/put/append; get returns "" for a missing key
+//                               (/root/reference/src/kvraft/client.rs:16-29)
+#pragma once
+
+#include "rsm.h"
+
+namespace kvraft {
+
+struct Op {
+  enum class Kind : uint8_t { Get, Put, Append } kind = Kind::Get;
+  std::string key;
+  std::string value;
+  // non-aggregate on purpose — see the gcc-12 note in rsm.h
+  Op() = default;
+  Op(Kind k, std::string key_, std::string value_)
+      : kind(k), key(std::move(key_)), value(std::move(value_)) {}
+};
+
+struct Kv {
+  using Command = Op;
+  using Output = std::string;
+
+  std::map<std::string, std::string> data;  // std::map: deterministic iteration
+
+  Output apply(const Op& op) {
+    switch (op.kind) {
+      case Op::Kind::Get: {
+        auto it = data.find(op.key);
+        return it == data.end() ? std::string() : it->second;
+      }
+      case Op::Kind::Put:
+        data[op.key] = op.value;
+        return {};
+      case Op::Kind::Append:
+        data[op.key] += op.value;
+        return {};
+    }
+    return {};
+  }
+
+  static void enc_cmd(Enc& e, const Op& op) {
+    e.u64(uint64_t(op.kind));
+    e.str(op.key);
+    e.str(op.value);
+  }
+  static Op dec_cmd(Dec& d) {
+    Op op;
+    op.kind = Op::Kind(d.u64());
+    op.key = d.str();
+    op.value = d.str();
+    return op;
+  }
+
+  void save(Enc& e) const {
+    e.u64(data.size());
+    for (auto& [k, v] : data) {
+      e.str(k);
+      e.str(v);
+    }
+  }
+  void load(Dec& d) {
+    data.clear();
+    uint64_t n = d.u64();
+    for (uint64_t i = 0; i < n; i++) {
+      auto k = d.str();
+      data[k] = d.str();
+    }
+  }
+};
+
+using KvServer = RsmServer<Kv>;
+
+// client.rs:5-30
+class KvClerk {
+ public:
+  KvClerk(Sim* sim, std::vector<Addr> servers, uint64_t id)
+      : core_(sim, std::move(servers), id) {}
+
+  Task<std::string> get(std::string key) {
+    return core_.call(Op{Op::Kind::Get, std::move(key), {}});
+  }
+  Task<std::string> put(std::string key, std::string value) {
+    return core_.call(Op{Op::Kind::Put, std::move(key), std::move(value)});
+  }
+  Task<std::string> append(std::string key, std::string value) {
+    return core_.call(Op{Op::Kind::Append, std::move(key), std::move(value)});
+  }
+  uint64_t id() const { return core_.id(); }
+
+ private:
+  ClerkCore<Kv> core_;
+};
+
+}  // namespace kvraft

@@ -1,0 +1,188 @@
+// KvTester — cluster + clerk harness for the Lab 3 suite, the C++ analogue of
+// the reference's kvraft tester (SURVEY.md §2 C12,
+// /root/reference/src/kvraft/tester.rs):
+//   * n KvServers at 0.0.1.(i+1); clerks at per-clerk sim addresses
+//     0.0.2.(id+1) with selective visibility (tester.rs:129-150,214-221)
+//   * pairwise partitioning partition(p1,p2) via connect2/disconnect2
+//     (tester.rs:114-124)
+//   * leader-in-minority partition builder make_partition (tester.rs:184-191)
+//   * server restart via kill+respawn (tester.rs:153-169)
+//   * metrics: log/snapshot size via fs file sizes (tester.rs:66-85),
+//     op counter for the end-of-test stats (tester.rs:273-275)
+#pragma once
+
+#include <cstdio>
+#include <memory>
+
+#include "../tests/framework.h"
+#include "kv.h"
+
+namespace kvraft {
+
+using simcore::make_addr;
+
+constexpr uint64_t KV_ELECTION_TIMEOUT = 1 * SEC;  // tests.rs:16
+
+class KvTester {
+ public:
+  KvTester(Sim* sim, int n, bool unreliable, std::optional<size_t> maxraftstate)
+      : sim_(sim), n_(n), maxraftstate_(maxraftstate) {
+    for (int i = 0; i < n; i++) addrs_.push_back(make_addr(0, 0, 1, i + 1));
+    servers_.resize(n);
+    auto& cfg = sim_->net_config();
+    if (unreliable) {  // tester.rs:30-33
+      cfg.packet_loss_rate = 0.1;
+      cfg.send_latency_min = 1 * MSEC;
+      cfg.send_latency_max = 27 * MSEC;
+    }
+    start_time_ = sim->now();
+  }
+
+  Task<void> init() {
+    for (int i = 0; i < n_; i++) co_await sim_->spawn(start_server(i));
+  }
+
+  Sim* sim() { return sim_; }
+  int n() const { return n_; }
+  std::vector<int> all() const {
+    std::vector<int> v(n_);
+    for (int i = 0; i < n_; i++) v[i] = i;
+    return v;
+  }
+
+  // ---- servers (tester.rs:153-169)
+  Task<void> start_server(int i) {
+    servers_[i] = co_await sim_->spawn(
+        addrs_[i], KvServer::boot(sim_, addrs_, i, maxraftstate_));
+  }
+  void shutdown_server(int i) {
+    sim_->kill(addrs_[i]);
+    servers_[i] = nullptr;
+  }
+
+  std::optional<int> leader() const {  // tester.rs:172-182
+    for (int i = 0; i < n_; i++)
+      if (servers_[i] && servers_[i]->is_leader()) return i;
+    return std::nullopt;
+  }
+
+  // ---- topology (tester.rs:88-124)
+  void connect(int i, const std::vector<int>& to) {
+    for (int j : to) sim_->connect2(addrs_[i], addrs_[j]);
+  }
+  void disconnect(int i, const std::vector<int>& from) {
+    for (int j : from) sim_->disconnect2(addrs_[i], addrs_[j]);
+  }
+  void connect_all() {
+    for (int i = 0; i < n_; i++) connect(i, all());
+  }
+  void partition(const std::vector<int>& p1, const std::vector<int>& p2) {
+    for (int i : p1) {
+      disconnect(i, p2);
+      connect(i, p1);
+    }
+    for (int i : p2) {
+      disconnect(i, p1);
+      connect(i, p2);
+    }
+  }
+  // split with the current leader in the minority (tester.rs:184-191)
+  std::pair<std::vector<int>, std::vector<int>> make_partition() const {
+    int l = leader().value_or(0);
+    std::vector<int> p1;
+    for (int i = 0; i < n_; i++)
+      if (i != l) p1.push_back(i);
+    std::vector<int> p2(p1.begin() + n_ / 2 + 1, p1.end());
+    p1.resize(n_ / 2 + 1);
+    p2.push_back(l);
+    return {p1, p2};
+  }
+
+  // ---- metrics (tester.rs:66-85)
+  size_t log_size() const {
+    size_t m = 0;
+    for (auto a : addrs_) m = std::max(m, sim_->fs_size(a, "state"));
+    return m;
+  }
+  size_t snapshot_size() const {
+    size_t m = 0;
+    for (auto a : addrs_) m = std::max(m, sim_->fs_size(a, "snapshot"));
+    return m;
+  }
+
+  // ---- clerks (tester.rs:129-150, 214-271)
+  class Clerk {
+   public:
+    Clerk(Sim* sim, Addr addr, std::shared_ptr<KvClerk> ck, uint64_t id,
+          std::shared_ptr<uint64_t> ops)
+        : sim_(sim), addr_(addr), ck_(std::move(ck)), id_(id),
+          ops_(std::move(ops)) {}
+
+    uint64_t id() const { return id_; }
+
+    // every op runs as the clerk's node so the sim routes/partitions it
+    // by the clerk's address (tester.rs:235-263)
+    Task<void> put(std::string k, std::string v) {
+      ++*ops_;
+      co_await sim_->spawn(addr_, ck_->put(std::move(k), std::move(v)));
+    }
+    Task<void> append(std::string k, std::string v) {
+      ++*ops_;
+      co_await sim_->spawn(addr_, ck_->append(std::move(k), std::move(v)));
+    }
+    Task<std::string> get(std::string k) {
+      ++*ops_;
+      co_return co_await sim_->spawn(addr_, ck_->get(std::move(k)));
+    }
+    Task<void> check(std::string k, std::string expected) {  // tester.rs:266-271
+      auto v = co_await sim_->spawn(addr_, ck_->get(k));
+      if (v != expected) {
+        std::fprintf(stderr, "get(%s) check failed: got %.120s want %.120s\n",
+                     k.c_str(), v.c_str(), expected.c_str());
+        std::abort();
+      }
+    }
+
+   private:
+    Sim* sim_;
+    Addr addr_;
+    std::shared_ptr<KvClerk> ck_;
+    uint64_t id_;
+    std::shared_ptr<uint64_t> ops_;
+  };
+
+  Clerk make_client(const std::vector<int>& to) {
+    uint64_t id = next_client_++;
+    connect_client(id, to);
+    return Clerk(sim_, clerk_addr(id),
+                 std::make_shared<KvClerk>(sim_, addrs_, id), id, ops_);
+  }
+
+  void connect_client(uint64_t id, const std::vector<int>& to) {
+    Addr a = clerk_addr(id);
+    sim_->connect(a);
+    for (int i = 0; i < n_; i++) sim_->disconnect2(a, addrs_[i]);
+    for (int i : to) sim_->connect2(a, addrs_[i]);
+  }
+
+  static Addr clerk_addr(uint64_t id) { return make_addr(0, 0, 2, id + 1); }
+
+  void end() const {  // tester.rs:197-211
+    std::printf("  ... elapsed %.2fs(virt) peers %d rpcs %llu ops %llu\n",
+                (sim_->now() - start_time_) / 1e9, n_,
+                (unsigned long long)(sim_->msg_count() / 2),
+                (unsigned long long)*ops_);
+  }
+
+ private:
+  Sim* sim_;
+  int n_;
+  std::optional<size_t> maxraftstate_;
+  uint64_t start_time_;
+  std::vector<Addr> addrs_;
+  std::vector<std::shared_ptr<KvServer>> servers_;
+  uint64_t next_client_ = 0;
+  std::shared_ptr<uint64_t> ops_ = std::make_shared<uint64_t>(0);
+};
+
+}  // namespace kvraft

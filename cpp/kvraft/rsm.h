@@ -1,0 +1,280 @@
+// rsm — the generic replicated-state-machine layer (L2 of SURVEY.md §1):
+// a service-agnostic server that funnels client commands through raft-core,
+// plus the generic retrying client. This implements in full what the
+// reference scaffolds as todo!() stubs:
+//
+//   trait State { Command; Output; apply }   (/root/reference/src/kvraft/server.rs:12-16)
+//   Server<S: State>::new(servers, me, max_raft_state)  (server.rs:31-46)
+//   Server::apply — submit via raft, await commit, dedup retries
+//                                            (server.rs:68-70, todo!())
+//   ClerkCore<Req, Rsp>::call — cycle servers, 500ms timeout, handle
+//     NotLeader{hint}/Timeout/Failed, retry forever  (client.rs:32-63)
+//   Error::{NotLeader{hint}, Timeout, Failed}  (/root/reference/src/kvraft/msg.rs:10-18)
+//
+// Design notes (not a port):
+//  * Exactly-once semantics: every request carries (client id, seq). The
+//    server keeps a per-client table of the last applied seq + its output;
+//    a retried command that already committed returns the cached output
+//    instead of re-applying. The table is part of the snapshot, and is
+//    rebuilt by log replay after a restart without snapshots.
+//  * The RPC handler coroutine submits to raft and then polls virtual time
+//    until the entry applies or the term moves on; polling is free in a
+//    discrete-event simulator.
+//  * Snapshot trigger: after each apply, if the on-disk raft "state" file
+//    exceeds max_raft_state, the server hands raft a snapshot (state + dup
+//    table). The tester asserts log ≤ 2×max_raft_state
+//    (/root/reference/src/kvraft/tests.rs:207-216).
+#pragma once
+
+#include <map>
+#include <memory>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "../raftcore/raft.h"
+
+namespace kvraft {
+
+using raftcore::ApplyMsg;
+using raftcore::Bytes;
+using raftcore::Dec;
+using raftcore::Enc;
+using raftcore::Raft;
+using simcore::Addr;
+using simcore::Channel;
+using simcore::MSEC;
+using simcore::SEC;
+using simcore::Sim;
+using simcore::Task;
+using simcore::TaskRef;
+
+// msg.rs:10-18 — Ok carries the output; the other three drive clerk retry.
+enum class Code : uint8_t { Ok, NotLeader, Failed };
+
+// NOTE: every message type that carries strings (or anything else
+// self-referential under SSO) MUST be a non-aggregate — i.e. declare a
+// constructor. gcc 12's coroutine codegen bitwise-relocates aggregate
+// prvalues crossing coroutine boundaries (parameters, awaiter temporaries)
+// without running move ctors, which corrupts SSO strings. Vectors and PODs
+// survive the relocation; strings do not. Non-aggregates take the proper
+// move-construction path.
+
+template <class Output>
+struct RsmReply {
+  Code code = Code::Failed;
+  int hint = -1;  // NotLeader: last observed leader
+  Output out{};
+  RsmReply() = default;
+  RsmReply(Code c, int h = -1, Output o = {})
+      : code(c), hint(h), out(std::move(o)) {}
+};
+
+// Wire request: the service command tagged with clerk identity for dup
+// detection (the requirement implied by server.rs:68-70's "dedup retries").
+template <class S>
+struct RsmRequest {
+  uint64_t client = 0;
+  uint64_t seq = 0;
+  typename S::Command cmd{};
+  using Reply = RsmReply<typename S::Output>;
+  RsmRequest() = default;
+  RsmRequest(uint64_t c, uint64_t s, typename S::Command cmd_)
+      : client(c), seq(s), cmd(std::move(cmd_)) {}
+};
+
+// Server<S: State> (server.rs:18-71). S must provide:
+//   using Command / using Output            (copyable values)
+//   Output apply(const Command&)
+//   static void enc_cmd(Enc&, const Command&) / static Command dec_cmd(Dec&)
+//   void save(Enc&) const / void load(Dec&)  (snapshot payload)
+template <class S>
+class RsmServer : public std::enable_shared_from_this<RsmServer<S>> {
+ public:
+  using Output = typename S::Output;
+  using Reply = RsmReply<Output>;
+
+  // Must be spawned on servers[me]'s address (the reference boots via
+  // local_handle(addr).spawn(KvServer::new), kvraft/tester.rs:164-168).
+  static Task<std::shared_ptr<RsmServer>> boot(Sim* sim,
+                                               std::vector<Addr> servers,
+                                               size_t me,
+                                               std::optional<size_t> max_raft_state) {
+    auto self = std::shared_ptr<RsmServer>(
+        new RsmServer(sim, servers, me, max_raft_state));
+    self->raft_ =
+        co_await sim->spawn(Raft::boot(sim, servers, me, self->apply_ch_));
+    sim->add_rpc_handler<RsmRequest<S>>([self](RsmRequest<S> req) {
+      return handle(self, std::move(req));
+    });
+    sim->spawn(applier(self));
+    co_return self;
+  }
+
+  uint64_t term() const { return raft_->term(); }        // server.rs:59-61
+  bool is_leader() const { return raft_->is_leader(); }  // server.rs:64-66
+  const S& state() const { return state_; }
+  Raft& raft() { return *raft_; }
+
+ protected:
+  RsmServer(Sim* sim, std::vector<Addr> servers, size_t me,
+            std::optional<size_t> mrs)
+      : sim_(sim), addr_(servers[me]), max_raft_state_(mrs) {}
+
+  // the reference's Server::apply (server.rs:68-70): submit, await, dedup
+  static Task<Reply> handle(std::shared_ptr<RsmServer> self, RsmRequest<S> req) {
+    Enc e;
+    e.u64(req.client);
+    e.u64(req.seq);
+    S::enc_cmd(e, req.cmd);
+    auto r = self->raft_->start(std::move(e.out));
+    if (!r.ok) co_return Reply{Code::NotLeader, r.hint};
+    while (self->applied_ < r.index) {
+      if (self->raft_->term() != r.term || !self->raft_->is_leader())
+        co_return Reply{Code::Failed};
+      co_await self->sim_->sleep(5 * MSEC);
+    }
+    auto it = self->dup_.find(req.client);
+    if (it != self->dup_.end() && it->second.seq >= req.seq)
+      co_return Reply{Code::Ok, -1, it->second.out};
+    // a different entry landed at our index (leader turnover): client retries
+    co_return Reply{Code::Failed};
+  }
+
+  static Task<void> applier(std::shared_ptr<RsmServer> self) {
+    for (;;) {
+      auto m = co_await self->apply_ch_.recv();
+      if (!m) break;
+      if (m->is_snapshot) {
+        if (self->raft_->cond_install_snapshot(m->term, m->index, m->data)) {
+          Dec d(m->data);
+          self->load_snapshot(d);
+          self->applied_ = m->index;
+        }
+      } else {
+        Dec d(m->data);
+        uint64_t client = d.u64();
+        uint64_t seq = d.u64();
+        auto cmd = S::dec_cmd(d);
+        auto& rec = self->dup_[client];
+        // Exactly-once contract: a clerk has ONE outstanding op and bumps seq
+        // only after the previous op returned Ok (i.e. committed), so seqs
+        // commit in order with no gaps. Entries with seq <= rec.seq are late
+        // duplicates of already-applied ops: skip, keep the cached output.
+        if (seq > rec.seq + 1) {
+          std::fprintf(stderr,
+                       "rsm: client %llu seq gap (%llu after %llu) — "
+                       "concurrent use of one clerk?\n",
+                       (unsigned long long)client, (unsigned long long)seq,
+                       (unsigned long long)rec.seq);
+          std::abort();
+        }
+        if (seq > rec.seq) {  // first time: apply; else serve cached output
+          rec.out = self->state_.apply(cmd);
+          rec.seq = seq;
+        }
+        self->applied_ = m->index;
+        self->maybe_snapshot(m->index);
+      }
+    }
+  }
+
+  void maybe_snapshot(uint64_t index) {
+    if (!max_raft_state_) return;
+    if (sim_->fs_size(addr_, "state") < *max_raft_state_) return;
+    Enc e;
+    save_snapshot(e);
+    raft_->snapshot(index, std::move(e.out));
+  }
+
+  void save_snapshot(Enc& e) const {
+    e.u64(dup_.size());
+    for (auto& [client, rec] : dup_) {  // std::map: deterministic order
+      e.u64(client);
+      e.u64(rec.seq);
+      enc_out(e, rec.out);
+    }
+    state_.save(e);
+  }
+  void load_snapshot(Dec& d) {
+    dup_.clear();
+    uint64_t n = d.u64();
+    for (uint64_t i = 0; i < n; i++) {
+      uint64_t client = d.u64();
+      auto& rec = dup_[client];
+      rec.seq = d.u64();
+      rec.out = dec_out(d);
+    }
+    state_ = S{};
+    state_.load(d);
+  }
+
+  static void enc_out(Enc& e, const std::string& s) { e.str(s); }
+  static std::string dec_out(Dec& d) { return d.str(); }
+  template <class T>
+  static void enc_out(Enc& e, const T& v) {
+    T::enc(e, v);
+  }
+  template <class T = Output>
+  static T dec_out(Dec& d)
+    requires(!std::is_same_v<T, std::string>)
+  {
+    return T::dec(d);
+  }
+
+  struct DupRec {
+    uint64_t seq = 0;
+    Output out{};
+  };
+
+  Sim* sim_;
+  Addr addr_;
+  std::optional<size_t> max_raft_state_;
+  Channel<ApplyMsg> apply_ch_;
+  std::shared_ptr<Raft> raft_;
+  S state_{};
+  std::map<uint64_t, DupRec> dup_;  // client -> last applied (seq, output)
+  uint64_t applied_ = 0;
+};
+
+// ClerkCore<Req, Rsp> (client.rs:32-63): cycle over servers with a 500 ms
+// per-call timeout, follow NotLeader hints, retry forever.
+// CONTRACT: one outstanding call() at a time per ClerkCore — seq advances
+// only after the previous op committed; the server's dup table relies on
+// gap-free per-client seqs (asserted in RsmServer::applier).
+template <class S>
+class ClerkCore {
+ public:
+  ClerkCore(Sim* sim, std::vector<Addr> servers, uint64_t client_id)
+      : sim_(sim), servers_(std::move(servers)), id_(client_id) {}
+
+  Task<typename S::Output> call(typename S::Command cmd) {
+    uint64_t seq = ++seq_;
+    size_t i = leader_;
+    for (;;) {
+      auto reply = co_await sim_->call_timeout(
+          servers_[i], RsmRequest<S>{id_, seq, cmd}, 500 * MSEC);  // client.rs:56
+      if (reply && reply->code == Code::Ok) {
+        leader_ = i;
+        co_return reply->out;
+      }
+      if (reply && reply->code == Code::NotLeader && reply->hint >= 0 &&
+          size_t(reply->hint) < servers_.size() && size_t(reply->hint) != i) {
+        i = size_t(reply->hint);
+      } else {
+        i = (i + 1) % servers_.size();
+      }
+    }
+  }
+
+  uint64_t id() const { return id_; }
+
+ private:
+  Sim* sim_;
+  std::vector<Addr> servers_;
+  uint64_t id_;
+  uint64_t seq_ = 0;
+  size_t leader_ = 0;
+};
+
+}  // namespace kvraft

@@ -130,6 +130,9 @@ class Task {
   }
   std::coroutine_handle<promise_type> release() { return std::exchange(h_, nullptr); }
 
+  // `co_await some_task()` = spawn on the current node, then join.
+  auto operator co_await() &&;
+
  private:
   std::coroutine_handle<promise_type> h_;
 };
@@ -150,6 +153,9 @@ class Task<void> {
     if (h_) h_.destroy();
   }
   std::coroutine_handle<promise_type> release() { return std::exchange(h_, nullptr); }
+
+  // `co_await some_task()` = spawn on the current node, then join.
+  auto operator co_await() &&;
 
  private:
   std::coroutine_handle<promise_type> h_;
@@ -287,6 +293,10 @@ class Sim {
   // ---- typed RPC. Req must define `using Reply = ...`. Handlers belong to
   // the registering node and are wiped by kill() (so calls to a dead node
   // time out, like the reference's crashed peers).
+  // CAUTION: message types carrying std::string members must declare a
+  // constructor (non-aggregate). gcc 12 bitwise-relocates aggregate prvalues
+  // across coroutine boundaries without running move ctors, which corrupts
+  // SSO strings (vectors/PODs survive). See the note in kvraft/rsm.h.
   template <class Req>
   void add_rpc_handler(std::function<Task<typename Req::Reply>(Req)> h);
   template <class Req>
@@ -480,57 +490,72 @@ void Sim::add_rpc_handler(std::function<Task<typename Req::Reply>(Req)> h) {
 template <class Req>
 auto Sim::call_timeout(Addr dst, Req req, uint64_t timeout_ns) {
   using Rsp = typename Req::Reply;
-  struct CallAwaiter {
-    Sim* sim;
-    Addr dst;
-    Req req;
-    uint64_t timeout_ns;
+  // All registration happens eagerly here (still inside the calling task's
+  // context, before suspension); the returned awaiter only parks the
+  // continuation. State lives on the heap behind a shared_ptr owned by the
+  // registered closures, so the awaiter carries no payload — gcc's coroutine
+  // codegen bitwise-relocates aggregate awaiter temporaries, which corrupts
+  // heap-owning members (observed with std::string payloads under ASan).
+  struct CallState {
     std::optional<Rsp> result;
-
-    bool await_ready() const { return false; }
-    void await_suspend(std::coroutine_handle<> h) {
-      Addr src = sim->cur_addr();
-      uint64_t tid = sim->cur_task();
-      uint64_t rpc_id = sim->next_rpc_id_++;
-      auto pend = std::make_shared<Pending>();
-      Sim* s = sim;
-      pend->finish = [this, s, tid, h](std::any reply) {
-        // guarded: never touch the awaiter/frame of a killed task
-        if (!s->task_live(tid)) return;
-        if (reply.has_value()) result = std::any_cast<Rsp>(std::move(reply));
-        s->schedule(s->now(), [s, tid, h] {
-          if (s->task_live(tid)) s->resume_in_context(tid, h);
-        });
-      };
-      s->pending_[rpc_id] = pend;
-      s->schedule(s->now() + timeout_ns, [s, rpc_id] {
-        auto it = s->pending_.find(rpc_id);
-        if (it == s->pending_.end()) return;
-        auto p = it->second;
-        s->pending_.erase(it);
-        if (!p->settled) {
-          p->settled = true;
-          p->finish(std::any());
-        }
-      });
-      // request leg: loss/latency drawn at send; link re-checked at delivery
-      uint64_t dt = s->link_up(src, dst) ? s->draw_delivery() : 0;
-      if (dt == 0) return;  // lost; the timeout will fire
-      Req r = req;
-      Addr d = dst;
-      s->schedule(s->now() + dt, [s, src, d, rpc_id, r = std::move(r)]() mutable {
-        if (!s->link_up(src, d)) return;
-        auto nit = s->handlers_.find(d);
-        if (nit == s->handlers_.end()) return;
-        auto hit = nit->second.find(std::type_index(typeid(Req)));
-        if (hit == nit->second.end()) return;  // node down / not serving
-        s->msg_count_++;
-        hit->second(src, rpc_id, std::any(std::move(r)));
-      });
-    }
-    std::optional<Rsp> await_resume() { return std::move(result); }
+    bool done = false;
+    std::coroutine_handle<> h{};
   };
-  return CallAwaiter{this, dst, std::move(req), timeout_ns};
+  auto st = std::make_shared<CallState>();
+  Sim* s = this;
+  Addr src = cur_addr_;
+  uint64_t tid = cur_task_;
+  uint64_t rpc_id = next_rpc_id_++;
+  auto pend = std::make_shared<Pending>();
+  pend->finish = [s, st, tid](std::any reply) {
+    if (reply.has_value()) st->result = std::any_cast<Rsp>(std::move(reply));
+    st->done = true;
+    // the resume closure re-captures `st` (keeps it alive through
+    // await_resume) and carries the kill-guard: a dead task never resumes
+    s->schedule(s->now(), [s, st, tid] {
+      if (s->task_live(tid) && st->h) s->resume_in_context(tid, st->h);
+    });
+  };
+  pending_[rpc_id] = pend;
+  schedule(now_ + timeout_ns, [s, rpc_id] {
+    auto it = s->pending_.find(rpc_id);
+    if (it == s->pending_.end()) return;
+    auto p = it->second;
+    s->pending_.erase(it);
+    if (!p->settled) {
+      p->settled = true;
+      p->finish(std::any());
+    }
+  });
+  // request leg: loss/latency drawn at send; link re-checked at delivery
+  uint64_t dt = link_up(src, dst) ? draw_delivery() : 0;
+  if (dt != 0) {
+    schedule(now_ + dt,
+             [s, src, dst, rpc_id, r = std::move(req)]() mutable {
+               if (!s->link_up(src, dst)) return;
+               auto nit = s->handlers_.find(dst);
+               if (nit == s->handlers_.end()) return;
+               auto hit = nit->second.find(std::type_index(typeid(Req)));
+               if (hit == nit->second.end()) return;  // node down / not serving
+               s->msg_count_++;
+               hit->second(src, rpc_id, std::any(std::move(r)));
+             });
+  }  // else: lost; the timeout will fire
+  struct CallAwaiter {
+    std::shared_ptr<CallState> st;
+    bool await_ready() const { return st->done; }
+    void await_suspend(std::coroutine_handle<> h) { st->h = h; }
+    std::optional<Rsp> await_resume() { return std::move(st->result); }
+  };
+  return CallAwaiter{std::move(st)};
+}
+
+template <class T>
+auto Task<T>::operator co_await() && {
+  return Sim::current()->spawn(std::move(*this));  // TaskRef is awaitable
+}
+inline auto Task<void>::operator co_await() && {
+  return Sim::current()->spawn(std::move(*this));
 }
 
 }  // namespace simcore

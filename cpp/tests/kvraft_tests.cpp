@@ -1,0 +1,363 @@
+// Lab 3 (fault-tolerant KV on Raft) suite — the 19 active tests of the
+// reference spec (SURVEY.md §4.2, /root/reference/src/kvraft/tests.rs)
+// re-expressed against the kvraft layer on simcore. Each test is a function
+// of the seed; failures replay with MADTPU_TEST_SEED=<n>.
+#include <cstdio>
+#include <memory>
+#include <string>
+
+#include "../kvraft/kv_tester.h"
+#include "framework.h"
+
+using namespace kvraft;
+using simcore::Sim;
+
+namespace {
+
+// tests.rs:21-43 — every append by `clnt` present exactly once, in order
+void check_clnt_appends(int clnt, const std::string& v, uint64_t count) {
+  std::optional<size_t> lastoff;
+  for (uint64_t j = 0; j < count; j++) {
+    char buf[64];
+    std::snprintf(buf, sizeof buf, "x %d %llu y", clnt, (unsigned long long)j);
+    std::string wanted = buf;
+    size_t off = v.find(wanted);
+    if (off == std::string::npos) {
+      std::fprintf(stderr, "client %d missing element %s in Append result\n",
+                   clnt, wanted.c_str());
+      std::abort();
+    }
+    size_t off1 = v.rfind(wanted);
+    if (off1 != off) {
+      std::fprintf(stderr, "duplicate element %s in Append result\n",
+                   wanted.c_str());
+      std::abort();
+    }
+    if (lastoff && off <= *lastoff) {
+      std::fprintf(stderr, "wrong order for element %s in Append result\n",
+                   wanted.c_str());
+      std::abort();
+    }
+    lastoff = off;
+  }
+}
+
+void check_concurrent_appends(const std::string& v,
+                              const std::vector<uint64_t>& counts) {
+  for (size_t i = 0; i < counts.size(); i++)
+    check_clnt_appends((int)i, v, counts[i]);
+}
+
+// tests.rs:107-131 — append/get loop predicting the value client-side
+simcore::Task<uint64_t> generic_client(Sim* sim, KvTester::Clerk ck, int cli,
+                                       std::shared_ptr<bool> done) {
+  uint64_t j = 0;
+  std::string last;
+  std::string key = std::to_string(cli);
+  co_await ck.put(key, last);
+  while (!*done) {
+    if (sim->rand_bool(0.5)) {
+      char buf[64];
+      std::snprintf(buf, sizeof buf, "x %d %llu y", cli, (unsigned long long)j);
+      last += buf;
+      co_await ck.append(key, buf);
+      j++;
+    } else {
+      std::string v = co_await ck.get(key);
+      if (v != last) {
+        std::fprintf(stderr, "client %d got wrong value for key %s\n", cli,
+                     key.c_str());
+        std::abort();
+      }
+    }
+  }
+  co_return j;
+}
+
+// tests.rs:134-157 — concurrent random repartitioner
+simcore::Task<void> repartitioner(Sim* sim, KvTester* t,
+                                  std::shared_ptr<bool> done) {
+  auto all = t->all();
+  int n = (int)all.size();
+  while (!*done) {
+    for (int i = n - 1; i > 0; i--)
+      std::swap(all[i], all[(int)(sim->rand_u64() % uint64_t(i + 1))]);
+    int k = (int)(sim->rand_u64() % uint64_t(n));
+    std::vector<int> left(all.begin(), all.begin() + k);
+    std::vector<int> right(all.begin() + k, all.end());
+    t->partition(left, right);
+    co_await sim->sleep(KV_ELECTION_TIMEOUT + sim->rand_range(0, 200) * MSEC);
+  }
+}
+
+// tests.rs:65-220
+simcore::Task<void> generic_main(Sim* sim, int nclients, bool unreliable,
+                                 bool crash, bool partitions,
+                                 std::optional<size_t> maxraftstate) {
+  constexpr int NSERVERS = 5;
+  KvTester t(sim, NSERVERS, unreliable, maxraftstate);
+  co_await sim->spawn(t.init());
+  auto ck = t.make_client(t.all());
+
+  for (int iter = 0; iter < 3; iter++) {
+    auto done = std::make_shared<bool>(false);
+    std::vector<simcore::TaskRef<uint64_t>> cas;
+    for (int cli = 0; cli < nclients; cli++)
+      cas.push_back(sim->spawn(
+          generic_client(sim, t.make_client(t.all()), cli, done)));
+
+    simcore::TaskRef<void> parter;
+    if (partitions) {
+      // let the clients run uninterrupted for a while first
+      co_await sim->sleep(1 * SEC);
+      parter = sim->spawn(repartitioner(sim, &t, done));
+    }
+    co_await sim->sleep(5 * SEC);
+    *done = true;
+
+    if (partitions) {
+      co_await parter;
+      // a client may be stuck on a minority server until a new term starts
+      t.connect_all();
+      co_await sim->sleep(KV_ELECTION_TIMEOUT);
+    }
+    if (crash) {
+      for (int i = 0; i < NSERVERS; i++) t.shutdown_server(i);
+      co_await sim->sleep(KV_ELECTION_TIMEOUT);
+      for (int i = 0; i < NSERVERS; i++) co_await sim->spawn(t.start_server(i));
+      t.connect_all();
+    }
+
+    for (int cli = 0; cli < nclients; cli++) {
+      uint64_t j = co_await cas[cli];
+      std::string v = co_await ck.get(std::to_string(cli));
+      check_clnt_appends(cli, v, j);
+    }
+
+    if (maxraftstate) {
+      if (t.log_size() > 2 * *maxraftstate) {
+        std::fprintf(stderr, "logs were not trimmed (%zu > 2*%zu)\n",
+                     t.log_size(), *maxraftstate);
+        std::abort();
+      }
+    }
+  }
+  t.end();
+}
+
+void run_generic(uint64_t seed, int nclients, bool unreliable, bool crash,
+                 bool partitions, std::optional<size_t> maxraftstate) {
+  Sim sim(seed);
+  MT_ASSERT(sim.run(generic_main(&sim, nclients, unreliable, crash, partitions,
+                                 maxraftstate)));
+}
+
+#define TSLEEP(ns) co_await sim->sleep(ns)
+
+}  // namespace
+
+// ------------------------------------------------------------------ 3A
+
+MT_TEST(kv_basic_3a) { run_generic(seed, 1, false, false, false, {}); }
+MT_TEST(kv_concurrent_3a) { run_generic(seed, 5, false, false, false, {}); }
+MT_TEST(kv_unreliable_3a) { run_generic(seed, 5, true, false, false, {}); }
+
+namespace {
+// tests.rs:241-274
+simcore::Task<void> one_key_client(KvTester::Clerk ck, int i, uint64_t upto) {
+  for (uint64_t n = 0; n < upto; n++) {
+    char buf[64];
+    std::snprintf(buf, sizeof buf, "x %d %llu y", i, (unsigned long long)n);
+    co_await ck.append("k", buf);
+  }
+}
+
+simcore::Task<void> unreliable_one_key_main(Sim* sim) {
+  KvTester t(sim, 3, true, {});
+  co_await sim->spawn(t.init());
+  auto ck = t.make_client(t.all());
+  co_await ck.put("k", "");
+
+  constexpr int NCLIENT = 5;
+  constexpr uint64_t UPTO = 10;
+  std::vector<simcore::TaskRef<void>> cas;
+  for (int i = 0; i < NCLIENT; i++)
+    cas.push_back(sim->spawn(one_key_client(t.make_client(t.all()), i, UPTO)));
+  for (auto& c : cas) co_await c;
+
+  std::string vx = co_await ck.get("k");
+  check_concurrent_appends(vx, std::vector<uint64_t>(NCLIENT, UPTO));
+  t.end();
+}
+}  // namespace
+
+MT_TEST(kv_unreliable_one_key_3a) {
+  Sim sim(seed);
+  MT_ASSERT(sim.run(unreliable_one_key_main(&sim)));
+}
+
+namespace {
+// tests.rs:277-339 — no progress in a minority partition until heal
+simcore::Task<void> one_partition_main(Sim* sim) {
+  KvTester t(sim, 5, false, {});
+  co_await sim->spawn(t.init());
+  auto all = t.all();
+  auto ck = t.make_client(all);
+  co_await ck.put("1", "13");
+
+  auto [p1, p2] = t.make_partition();
+  t.partition(p1, p2);
+
+  auto ckp1 = t.make_client(p1);    // majority
+  auto ckp2a = t.make_client(p2);   // minority (has the old leader)
+  auto ckp2b = t.make_client(p2);
+
+  co_await ckp1.put("1", "14");
+  co_await ckp1.check("1", "14");
+
+  // no progress in minority
+  auto put = sim->spawn(ckp2a.put("1", "15"));
+  auto get = sim->spawn(ckp2b.get("1"));
+  TSLEEP(1 * SEC);
+  MT_ASSERT(!put.done());  // put in minority must not complete
+  MT_ASSERT(!get.done());  // get in minority must not complete
+
+  co_await ckp1.check("1", "14");
+  co_await ckp1.put("1", "16");
+  co_await ckp1.check("1", "16");
+
+  // completion after heal
+  t.connect_all();
+  t.connect_client(ckp2a.id(), all);
+  t.connect_client(ckp2b.id(), all);
+  TSLEEP(KV_ELECTION_TIMEOUT);
+
+  uint64_t t0 = sim->now();
+  while ((!put.done() || !get.done()) && sim->now() - t0 < 3 * SEC)
+    TSLEEP(20 * MSEC);
+  MT_ASSERT(put.done());  // put must complete after heal
+  MT_ASSERT(get.done());  // get must complete after heal
+
+  co_await ck.check("1", "15");
+  t.end();
+}
+}  // namespace
+
+MT_TEST(kv_one_partition_3a) {
+  Sim sim(seed);
+  MT_ASSERT(sim.run(one_partition_main(&sim)));
+}
+
+MT_TEST(kv_many_partitions_one_client_3a) {
+  run_generic(seed, 1, false, false, true, {});
+}
+MT_TEST(kv_many_partitions_many_clients_3a) {
+  run_generic(seed, 5, false, false, true, {});
+}
+MT_TEST(kv_persist_one_client_3a) {
+  run_generic(seed, 1, false, true, false, {});
+}
+MT_TEST(kv_persist_concurrent_3a) {
+  run_generic(seed, 5, false, true, false, {});
+}
+MT_TEST(kv_persist_concurrent_unreliable_3a) {
+  run_generic(seed, 5, true, true, false, {});
+}
+MT_TEST(kv_persist_partition_3a) {
+  run_generic(seed, 5, false, true, true, {});
+}
+MT_TEST(kv_persist_partition_unreliable_3a) {
+  run_generic(seed, 5, true, true, true, {});
+}
+
+// ------------------------------------------------------------------ 3B
+
+namespace {
+// tests.rs:397-455 — lagging node catches up via InstallSnapshot; majority
+// discards committed entries even when a minority doesn't respond
+simcore::Task<void> snapshot_rpc_main(Sim* sim) {
+  constexpr size_t MAXRAFTSTATE = 1000;
+  KvTester t(sim, 3, false, MAXRAFTSTATE);
+  co_await sim->spawn(t.init());
+  auto all = t.all();
+  auto ck = t.make_client(all);
+
+  co_await ck.put("a", "A");
+  co_await ck.check("a", "A");
+
+  // a bunch of puts into the majority partition
+  t.partition({0, 1}, {2});
+  {
+    auto ck1 = t.make_client({0, 1});
+    for (int i = 0; i < 50; i++) {
+      auto s = std::to_string(i);
+      co_await ck1.put(s, s);
+    }
+    TSLEEP(KV_ELECTION_TIMEOUT);
+    co_await ck1.put("b", "B");
+  }
+  MT_ASSERT(t.log_size() <= 2 * MAXRAFTSTATE);  // logs must be trimmed
+
+  // now a group that needs the lagging server, so it must catch up
+  t.partition({0, 2}, {1});
+  {
+    auto ck1 = t.make_client({0, 2});
+    co_await ck1.put("c", "C");
+    co_await ck1.put("d", "D");
+    co_await ck1.check("a", "A");
+    co_await ck1.check("b", "B");
+    co_await ck1.check("1", "1");
+    co_await ck1.check("49", "49");
+  }
+
+  t.partition({0, 1, 2}, {});
+  co_await ck.put("e", "E");
+  co_await ck.check("c", "C");
+  co_await ck.check("e", "E");
+  co_await ck.check("1", "1");
+  t.end();
+}
+
+// tests.rs:459-493 — snapshots must stay small
+simcore::Task<void> snapshot_size_main(Sim* sim) {
+  constexpr size_t MAXRAFTSTATE = 1000;
+  constexpr size_t MAXSNAPSHOT = 500;
+  KvTester t(sim, 3, false, MAXRAFTSTATE);
+  co_await sim->spawn(t.init());
+  auto ck = t.make_client(t.all());
+
+  for (int i = 0; i < 200; i++) {
+    co_await ck.put("x", "0");
+    co_await ck.check("x", "0");
+    co_await ck.put("x", "1");
+    co_await ck.check("x", "1");
+  }
+  MT_ASSERT(t.log_size() <= 2 * MAXRAFTSTATE);
+  MT_ASSERT(t.snapshot_size() <= MAXSNAPSHOT);
+  t.end();
+}
+}  // namespace
+
+MT_TEST(kv_snapshot_rpc_3b) {
+  Sim sim(seed);
+  MT_ASSERT(sim.run(snapshot_rpc_main(&sim)));
+}
+MT_TEST(kv_snapshot_size_3b) {
+  Sim sim(seed);
+  MT_ASSERT(sim.run(snapshot_size_main(&sim)));
+}
+MT_TEST(kv_snapshot_recover_3b) {
+  run_generic(seed, 1, false, true, false, 1000);
+}
+MT_TEST(kv_snapshot_recover_many_clients_3b) {
+  run_generic(seed, 20, false, true, false, 1000);
+}
+MT_TEST(kv_snapshot_unreliable_3b) {
+  run_generic(seed, 5, true, false, false, 1000);
+}
+MT_TEST(kv_snapshot_unreliable_recover_3b) {
+  run_generic(seed, 5, true, true, false, 1000);
+}
+MT_TEST(kv_snapshot_unreliable_recover_concurrent_partition_3b) {
+  run_generic(seed, 5, true, true, true, 1000);
+}
