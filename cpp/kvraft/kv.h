@@ -55,6 +55,9 @@ struct Kv {
     return op;
   }
 
+  static void enc_out(Enc& e, const std::string& s) { e.str(s); }
+  static std::string dec_out(Dec& d) { return d.str(); }
+
   void save(Enc& e) const {
     e.u64(data.size());
     for (auto& [k, v] : data) {

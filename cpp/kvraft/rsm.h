@@ -87,6 +87,7 @@ struct RsmRequest {
 //   using Command / using Output            (copyable values)
 //   Output apply(const Command&)
 //   static void enc_cmd(Enc&, const Command&) / static Command dec_cmd(Dec&)
+//   static void enc_out(Enc&, const Output&) / static Output dec_out(Dec&)
 //   void save(Enc&) const / void load(Dec&)  (snapshot payload)
 template <class S>
 class RsmServer : public std::enable_shared_from_this<RsmServer<S>> {
@@ -192,7 +193,7 @@ class RsmServer : public std::enable_shared_from_this<RsmServer<S>> {
     for (auto& [client, rec] : dup_) {  // std::map: deterministic order
       e.u64(client);
       e.u64(rec.seq);
-      enc_out(e, rec.out);
+      S::enc_out(e, rec.out);
     }
     state_.save(e);
   }
@@ -203,23 +204,10 @@ class RsmServer : public std::enable_shared_from_this<RsmServer<S>> {
       uint64_t client = d.u64();
       auto& rec = dup_[client];
       rec.seq = d.u64();
-      rec.out = dec_out(d);
+      rec.out = S::dec_out(d);
     }
     state_ = S{};
     state_.load(d);
-  }
-
-  static void enc_out(Enc& e, const std::string& s) { e.str(s); }
-  static std::string dec_out(Dec& d) { return d.str(); }
-  template <class T>
-  static void enc_out(Enc& e, const T& v) {
-    T::enc(e, v);
-  }
-  template <class T = Output>
-  static T dec_out(Dec& d)
-    requires(!std::is_same_v<T, std::string>)
-  {
-    return T::dec(d);
   }
 
   struct DupRec {

@@ -1,0 +1,294 @@
+// shard_ctrler — the Lab 4A replicated configuration service on the generic
+// RSM layer (SURVEY.md §2 C8, /root/reference/src/shard_ctrler/):
+//   N_SHARDS = 10                      (mod.rs:9)
+//   Config{num, shards: [Gid;10], groups: gid -> servers}   (msg.rs:10-18)
+//   Op::{Query{num}, Join{groups}, Leave{gids}, Move{shard,gid}} (msg.rs:20-37)
+//   Output = Option<Config>            (server.rs:14)
+//   Clerk::{query, query_at, join, leave, move_}            (client.rs:16-34)
+//
+// Rebalancing on Join/Leave must be balanced (max−min ≤ 1 across groups),
+// move as few shards as possible, and be deterministic across replicas —
+// all containers here are ordered (std::map), never hash-ordered
+// (reference README.md:79 bans order-dependent HashMap iteration).
+#pragma once
+
+#include <algorithm>
+#include <array>
+#include <optional>
+
+#include "../kvraft/rsm.h"
+
+namespace shard_ctrler {
+
+using kvraft::ClerkCore;
+using kvraft::RsmServer;
+using raftcore::Dec;
+using raftcore::Enc;
+using simcore::Addr;
+using simcore::Sim;
+using simcore::Task;
+
+constexpr size_t N_SHARDS = 10;  // mod.rs:9
+using Gid = uint64_t;
+constexpr uint64_t LATEST = ~0ull;  // Query{u64::MAX} = latest (client.rs:17)
+
+struct Config {
+  uint64_t num = 0;
+  std::array<Gid, N_SHARDS> shards{};          // shard -> gid (0 = unassigned)
+  std::map<Gid, std::vector<Addr>> groups;     // gid -> servers
+  // non-aggregate on purpose — see the gcc-12 note in kvraft/rsm.h (std::map
+  // headers are self-referential, bitwise relocation corrupts them)
+  Config() = default;
+  bool operator==(const Config& o) const {
+    return num == o.num && shards == o.shards && groups == o.groups;
+  }
+
+  static void enc(Enc& e, const Config& c) {
+    e.u64(c.num);
+    for (auto g : c.shards) e.u64(g);
+    e.u64(c.groups.size());
+    for (auto& [gid, srvs] : c.groups) {
+      e.u64(gid);
+      e.u64(srvs.size());
+      for (auto a : srvs) e.u64(a);
+    }
+  }
+  static Config dec(Dec& d) {
+    Config c;
+    c.num = d.u64();
+    for (auto& g : c.shards) g = d.u64();
+    uint64_t ng = d.u64();
+    for (uint64_t i = 0; i < ng; i++) {
+      Gid gid = d.u64();
+      auto& srvs = c.groups[gid];
+      uint64_t ns = d.u64();
+      for (uint64_t j = 0; j < ns; j++) srvs.push_back(Addr(d.u64()));
+    }
+    return c;
+  }
+};
+
+struct CtrlOp {
+  enum class Kind : uint8_t { Query, Join, Leave, Move } kind = Kind::Query;
+  uint64_t num = 0;                          // Query
+  std::map<Gid, std::vector<Addr>> groups;   // Join
+  std::vector<Gid> gids;                     // Leave
+  uint64_t shard = 0;                        // Move
+  Gid gid = 0;                               // Move
+  CtrlOp() = default;  // non-aggregate (gcc-12, see kvraft/rsm.h)
+  explicit CtrlOp(Kind k) : kind(k) {}
+
+  static CtrlOp query(uint64_t num) {
+    CtrlOp op(Kind::Query);
+    op.num = num;
+    return op;
+  }
+  static CtrlOp join(std::map<Gid, std::vector<Addr>> groups) {
+    CtrlOp op(Kind::Join);
+    op.groups = std::move(groups);
+    return op;
+  }
+  static CtrlOp leave(std::vector<Gid> gids) {
+    CtrlOp op(Kind::Leave);
+    op.gids = std::move(gids);
+    return op;
+  }
+  static CtrlOp move_(uint64_t shard, Gid gid) {
+    CtrlOp op(Kind::Move);
+    op.shard = shard;
+    op.gid = gid;
+    return op;
+  }
+};
+
+// The replicated state: full config history (query_at must answer
+// historical configs across restarts, tests.rs:64-75). configs_[i].num == i.
+struct ShardInfo {
+  using Command = CtrlOp;
+  using Output = std::optional<Config>;
+
+  std::vector<Config> configs{Config{}};  // config 0: all shards -> gid 0
+
+  Output apply(const CtrlOp& op) {
+    switch (op.kind) {
+      case CtrlOp::Kind::Query: {
+        uint64_t n = op.num;
+        if (n >= configs.size()) n = configs.size() - 1;
+        return configs[n];
+      }
+      case CtrlOp::Kind::Join: {
+        Config c = configs.back();
+        c.num++;
+        for (auto& [gid, srvs] : op.groups) c.groups[gid] = srvs;
+        rebalance(c);
+        configs.push_back(std::move(c));
+        return std::nullopt;
+      }
+      case CtrlOp::Kind::Leave: {
+        Config c = configs.back();
+        c.num++;
+        for (Gid g : op.gids) c.groups.erase(g);
+        rebalance(c);
+        configs.push_back(std::move(c));
+        return std::nullopt;
+      }
+      case CtrlOp::Kind::Move: {
+        if (op.shard >= N_SHARDS) return std::nullopt;  // reject, don't UB
+        Config c = configs.back();
+        c.num++;
+        c.shards[op.shard] = op.gid;
+        configs.push_back(std::move(c));
+        return std::nullopt;
+      }
+    }
+    return std::nullopt;
+  }
+
+  // Deterministic minimal-move rebalance: compute per-group targets
+  // (base = N/G, the `extra` groups currently holding the most — ties by
+  // ascending gid — keep one more), release only surplus shards, hand them
+  // to groups below target. Shards never move between two groups that both
+  // keep their target, which is exactly the minimality the tests assert
+  // (tests.rs:122-163, 239-278).
+  static void rebalance(Config& c) {
+    if (c.groups.empty()) {
+      c.shards.fill(0);
+      return;
+    }
+    size_t ngroups = c.groups.size();
+    size_t base = N_SHARDS / ngroups;
+    size_t extra = N_SHARDS % ngroups;
+
+    std::map<Gid, size_t> count;
+    for (auto& [gid, _] : c.groups) count[gid] = 0;
+    for (size_t s = 0; s < N_SHARDS; s++) {
+      auto it = count.find(c.shards[s]);
+      if (it == count.end())
+        c.shards[s] = 0;  // owner gone (or never assigned): orphan
+      else
+        it->second++;
+    }
+
+    // pick which groups get base+1: the currently-largest (fewest moves),
+    // ties broken by ascending gid for cross-replica determinism
+    std::vector<std::pair<Gid, size_t>> order(count.begin(), count.end());
+    std::stable_sort(order.begin(), order.end(),
+                     [](const auto& a, const auto& b) {
+                       return a.second != b.second ? a.second > b.second
+                                                   : a.first < b.first;
+                     });
+    std::map<Gid, size_t> target;
+    for (size_t i = 0; i < order.size(); i++)
+      target[order[i].first] = base + (i < extra ? 1 : 0);
+
+    // release surplus (highest shard index first — any fixed rule works)
+    std::vector<size_t> orphans;
+    for (size_t s = 0; s < N_SHARDS; s++)
+      if (c.shards[s] == 0) orphans.push_back(s);
+    for (size_t s = N_SHARDS; s-- > 0;) {
+      Gid g = c.shards[s];
+      if (g != 0 && count[g] > target[g]) {
+        count[g]--;
+        c.shards[s] = 0;
+        orphans.push_back(s);
+      }
+    }
+    std::sort(orphans.begin(), orphans.end());
+
+    // fill deficits in ascending gid order
+    size_t oi = 0;
+    for (auto& [gid, tgt] : target) {
+      while (count[gid] < tgt) {
+        c.shards[orphans[oi++]] = gid;
+        count[gid]++;
+      }
+    }
+  }
+
+  static void enc_cmd(Enc& e, const CtrlOp& op) {
+    e.u64(uint64_t(op.kind));
+    e.u64(op.num);
+    e.u64(op.groups.size());
+    for (auto& [gid, srvs] : op.groups) {
+      e.u64(gid);
+      e.u64(srvs.size());
+      for (auto a : srvs) e.u64(a);
+    }
+    e.u64(op.gids.size());
+    for (auto g : op.gids) e.u64(g);
+    e.u64(op.shard);
+    e.u64(op.gid);
+  }
+  static CtrlOp dec_cmd(Dec& d) {
+    CtrlOp op;
+    op.kind = CtrlOp::Kind(d.u64());
+    op.num = d.u64();
+    uint64_t ng = d.u64();
+    for (uint64_t i = 0; i < ng; i++) {
+      Gid gid = d.u64();
+      auto& srvs = op.groups[gid];
+      uint64_t ns = d.u64();
+      for (uint64_t j = 0; j < ns; j++) srvs.push_back(Addr(d.u64()));
+    }
+    uint64_t ngids = d.u64();
+    for (uint64_t i = 0; i < ngids; i++) op.gids.push_back(d.u64());
+    op.shard = d.u64();
+    op.gid = d.u64();
+    return op;
+  }
+
+  static void enc_out(Enc& e, const Output& o) {
+    e.u64(o.has_value() ? 1 : 0);
+    if (o) Config::enc(e, *o);
+  }
+  static Output dec_out(Dec& d) {
+    if (d.u64() == 0) return std::nullopt;
+    return Config::dec(d);
+  }
+
+  void save(Enc& e) const {
+    e.u64(configs.size());
+    for (auto& c : configs) Config::enc(e, c);
+  }
+  void load(Dec& d) {
+    configs.clear();
+    uint64_t n = d.u64();
+    for (uint64_t i = 0; i < n; i++) configs.push_back(Config::dec(d));
+  }
+};
+
+using ShardCtrler = RsmServer<ShardInfo>;
+
+// client.rs:9-35 — the clerk reuses the generic retrying core
+class CtrlerClerk {
+ public:
+  CtrlerClerk(Sim* sim, std::vector<Addr> servers, uint64_t id)
+      : core_(sim, std::move(servers), id) {}
+
+  Task<Config> query() { return unwrap(core_.call(CtrlOp::query(LATEST))); }
+  Task<Config> query_at(uint64_t num) {
+    return unwrap(core_.call(CtrlOp::query(num)));
+  }
+  Task<void> join(std::map<Gid, std::vector<Addr>> groups) {
+    return drop(core_.call(CtrlOp::join(std::move(groups))));
+  }
+  Task<void> leave(std::vector<Gid> gids) {
+    return drop(core_.call(CtrlOp::leave(std::move(gids))));
+  }
+  Task<void> move_(uint64_t shard, Gid gid) {
+    return drop(core_.call(CtrlOp::move_(shard, gid)));
+  }
+  uint64_t id() const { return core_.id(); }
+
+ private:
+  static Task<Config> unwrap(Task<std::optional<Config>> t) {
+    auto c = co_await std::move(t);
+    co_return *c;
+  }
+  static Task<void> drop(Task<std::optional<Config>> t) {
+    co_await std::move(t);
+  }
+  ClerkCore<ShardInfo> core_;
+};
+
+}  // namespace shard_ctrler

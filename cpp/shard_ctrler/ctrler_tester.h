@@ -1,0 +1,114 @@
+// Shard-ctrler tester — the C++ analogue of the reference's minimal 4A
+// harness (SURVEY.md §2 C14, /root/reference/src/shard_ctrler/tester.rs):
+// start/shutdown servers, leader probe, and the config checker `check`:
+// expected membership, no orphan shards, balance max ≤ min+1
+// (tester.rs:113-150). No partitioning verbs in this lab.
+#pragma once
+
+#include <cstdio>
+#include <memory>
+
+#include "../tests/framework.h"
+#include "ctrler.h"
+
+namespace shard_ctrler {
+
+using simcore::make_addr;
+using simcore::MSEC;
+using simcore::SEC;
+
+class CtrlerTester {
+ public:
+  CtrlerTester(Sim* sim, int n, bool unreliable) : sim_(sim), n_(n) {
+    for (int i = 0; i < n; i++) addrs_.push_back(make_addr(0, 0, 1, i + 1));
+    servers_.resize(n);
+    if (unreliable) {
+      auto& cfg = sim_->net_config();
+      cfg.packet_loss_rate = 0.1;
+      cfg.send_latency_min = 1 * MSEC;
+      cfg.send_latency_max = 27 * MSEC;
+    }
+    start_time_ = sim->now();
+  }
+
+  Task<void> init() {
+    for (int i = 0; i < n_; i++) co_await sim_->spawn(start_server(i));
+  }
+
+  Sim* sim() { return sim_; }
+
+  Task<void> start_server(int i) {  // tester.rs:74-80
+    servers_[i] = co_await sim_->spawn(
+        addrs_[i], ShardCtrler::boot(sim_, addrs_, i, std::nullopt));
+  }
+  void shutdown_server(int i) {  // tester.rs:66-70
+    sim_->kill(addrs_[i]);
+    servers_[i] = nullptr;
+  }
+
+  std::optional<int> leader() const {  // tester.rs:82-92
+    for (int i = 0; i < n_; i++)
+      if (servers_[i] && servers_[i]->is_leader()) return i;
+    return std::nullopt;
+  }
+
+  CtrlerClerk make_client() {
+    return CtrlerClerk(sim_, addrs_, next_client_++);
+  }
+
+  // tester.rs:113-150
+  static Task<void> check(CtrlerClerk& ck, std::vector<Gid> gids) {
+    Config c = co_await ck.query();
+    MT_ASSERT_EQ(c.groups.size(), gids.size());
+    for (Gid g : gids) {
+      if (!c.groups.count(g)) {
+        std::fprintf(stderr, "check: missing group %llu\n",
+                     (unsigned long long)g);
+        std::abort();
+      }
+    }
+    // stronger than the reference (tester.rs:122-130, empty-groups only):
+    // every shard's owner must always be a live group, or 0 when none exist
+    for (size_t s = 0; s < N_SHARDS; s++) {
+      Gid g = c.shards[s];
+      bool ok = c.groups.empty() ? (g == 0 || c.groups.count(g))
+                                 : c.groups.count(g) > 0;
+      if (!ok) {
+        std::fprintf(stderr, "check: shard %zu -> invalid group %llu\n", s,
+                     (unsigned long long)g);
+        std::abort();
+      }
+    }
+    if (!c.groups.empty()) {
+      std::map<Gid, size_t> counts;
+      for (Gid g : c.shards) counts[g]++;
+      size_t mn = N_SHARDS + 1, mx = 0;
+      for (auto& [gid, _] : c.groups) {
+        size_t cnt = counts.count(gid) ? counts[gid] : 0;
+        mn = std::min(mn, cnt);
+        mx = std::max(mx, cnt);
+      }
+      if (mx > mn + 1) {
+        std::fprintf(stderr, "check: imbalanced sharding, max %zu min %zu\n",
+                     mx, mn);
+        std::abort();
+      }
+    }
+  }
+
+  void end() const {
+    std::printf("  ... elapsed %.2fs(virt) peers %d rpcs %llu\n",
+                (sim_->now() - start_time_) / 1e9, n_,
+                (unsigned long long)(sim_->msg_count() / 2));
+  }
+
+ private:
+  Sim* sim_;
+  int n_;
+  uint64_t start_time_;
+  std::vector<Addr> addrs_;
+  std::vector<std::shared_ptr<ShardCtrler>> servers_;
+  uint64_t next_client_ = 0;
+};
+
+}  // namespace shard_ctrler

@@ -1,0 +1,264 @@
+// Lab 4A (shard controller) suite — the 2 mega-tests of the reference spec
+// (SURVEY.md §4.3, /root/reference/src/shard_ctrler/tests.rs) re-expressed
+// against the shard_ctrler layer on simcore. The minimal-transfer phases
+// assert over the actual surviving gids (the reference's loop over 1..=npara
+// is vacuous there since its gids are 100-series/1000-series).
+//
+// NOTE: no braced-init-list may appear in a statement containing co_await —
+// gcc 12 cannot copy an initializer_list backing array into the coroutine
+// frame ("array used as initializer"). The variadic builders below keep the
+// braces out of co_await statements.
+#include <cstdio>
+
+#include "../shard_ctrler/ctrler_tester.h"
+#include "framework.h"
+
+using namespace shard_ctrler;
+using simcore::Sim;
+using simcore::SEC;
+
+namespace {
+
+using GroupMap = std::map<Gid, std::vector<Addr>>;
+
+template <class... T>
+std::vector<Addr> srvs(T... xs) {
+  return {make_addr(0, 0, 0, unsigned(xs))...};
+}
+template <class... T>
+std::vector<Gid> gidv(T... xs) {
+  return {Gid(xs)...};
+}
+GroupMap grp(Gid g, std::vector<Addr> a) {
+  GroupMap m;
+  m.emplace(g, std::move(a));
+  return m;
+}
+
+// old groups must not gain (join phase) / lose (leave phase) shards
+void assert_minimal(const Config& before, const Config& after,
+                    const std::vector<Gid>& old_gids, const char* what) {
+  for (Gid g : old_gids) {
+    for (size_t j = 0; j < N_SHARDS; j++) {
+      if (after.shards[j] == g && before.shards[j] != g) {
+        std::fprintf(stderr, "non-minimal transfer after %s (gid %llu)\n",
+                     what, (unsigned long long)g);
+        std::abort();
+      }
+    }
+  }
+}
+
+// tests.rs:104-120
+Task<void> basic_concurrent_client(CtrlerClerk ck, Gid gid) {
+  co_await ck.join(grp(gid + 1000, srvs(gid + 1)));
+  co_await ck.join(grp(gid, srvs(gid + 2)));
+  co_await ck.leave(gidv(gid + 1000));
+}
+
+Task<void> basic_main(Sim* sim) {
+  constexpr int NSERVERS = 3;
+  CtrlerTester t(sim, NSERVERS, false);
+  co_await sim->spawn(t.init());
+  auto ck = t.make_client();
+
+  // Basic leave/join (tests.rs:29-62)
+  std::vector<Config> cfa;
+  cfa.push_back(co_await ck.query());
+  co_await CtrlerTester::check(ck, gidv());
+
+  auto addr1 = srvs(11, 12, 13);
+  co_await ck.join(grp(1, addr1));
+  co_await CtrlerTester::check(ck, gidv(1));
+  cfa.push_back(co_await ck.query());
+
+  auto addr2 = srvs(21, 22, 23);
+  co_await ck.join(grp(2, addr2));
+  co_await CtrlerTester::check(ck, gidv(1, 2));
+  cfa.push_back(co_await ck.query());
+
+  {
+    Config cfx = co_await ck.query();
+    MT_ASSERT(cfx.groups[1] == addr1);
+    MT_ASSERT(cfx.groups[2] == addr2);
+  }
+
+  co_await ck.leave(gidv(1));
+  co_await CtrlerTester::check(ck, gidv(2));
+  cfa.push_back(co_await ck.query());
+
+  co_await ck.leave(gidv(2));
+  cfa.push_back(co_await ck.query());
+
+  // Historical queries across rolling restarts (tests.rs:64-75)
+  for (int s = 0; s < NSERVERS; s++) {
+    t.shutdown_server(s);
+    for (auto& cf : cfa) {
+      Config c = co_await ck.query_at(cf.num);
+      MT_ASSERT(c == cf);
+    }
+    co_await sim->spawn(t.start_server(s));
+  }
+
+  // Move (tests.rs:77-102)
+  co_await ck.join(grp(503, srvs(31, 32, 33)));
+  co_await ck.join(grp(504, srvs(41, 42, 43)));
+  for (size_t i = 0; i < N_SHARDS; i++) {
+    Config cf = co_await ck.query();
+    Gid shard_gid = i < N_SHARDS / 2 ? 503 : 504;
+    co_await ck.move_(i, shard_gid);
+    if (cf.shards[i] != shard_gid) {
+      Config cf1 = co_await ck.query();
+      MT_ASSERT(cf1.num > cf.num);  // Move must advance the config number
+    }
+  }
+  {
+    Config cf2 = co_await ck.query();
+    for (size_t i = 0; i < N_SHARDS; i++)
+      MT_ASSERT_EQ(cf2.shards[i], (i < N_SHARDS / 2 ? 503u : 504u));
+  }
+  co_await ck.leave(gidv(503));
+  co_await ck.leave(gidv(504));
+
+  // Concurrent leave/join (tests.rs:104-120)
+  constexpr uint64_t NPARA = 10;
+  std::vector<Gid> gids;
+  for (uint64_t i = 0; i < NPARA; i++) gids.push_back(i * 10 + 100);
+  {
+    std::vector<simcore::TaskRef<void>> hs;
+    for (Gid gid : gids)
+      hs.push_back(sim->spawn(basic_concurrent_client(t.make_client(), gid)));
+    for (auto& h : hs) co_await h;
+  }
+  co_await CtrlerTester::check(ck, gids);
+
+  // Minimal transfers after joins (tests.rs:122-143)
+  Config c1 = co_await ck.query();
+  for (uint64_t i = 0; i < 5; i++) {
+    Gid gid = NPARA + 1 + i;
+    // duplicate gid+2 mirrors the reference fixture (tests.rs:128)
+    co_await ck.join(grp(gid, srvs(gid + 1, gid + 2, gid + 2)));
+  }
+  Config c2 = co_await ck.query();
+  assert_minimal(c1, c2, gids, "Join()s");
+
+  // Minimal transfers after leaves (tests.rs:145-163)
+  for (uint64_t i = 0; i < 5; i++) co_await ck.leave(gidv(NPARA + 1 + i));
+  Config c3 = co_await ck.query();
+  for (Gid g : gids) {
+    for (size_t j = 0; j < N_SHARDS; j++)
+      MT_ASSERT(!(c2.shards[j] == g && c3.shards[j] != g));
+  }
+  t.end();
+}
+
+// tests.rs:216-237
+Task<void> multi_concurrent_client(CtrlerClerk ck, Gid gid) {
+  GroupMap m = grp(gid, srvs(gid + 1, gid + 2, gid + 3));
+  m.emplace(gid + 1000, srvs(gid + 1000 + 1));
+  m.emplace(gid + 2000, srvs(gid + 2000 + 1));
+  co_await ck.join(std::move(m));
+  co_await ck.leave(gidv(gid + 1000, gid + 2000));
+}
+
+Task<void> multi_main(Sim* sim) {
+  constexpr int NSERVERS = 3;
+  CtrlerTester t(sim, NSERVERS, false);
+  co_await sim->spawn(t.init());
+  auto ck = t.make_client();
+
+  // Multi-group leave/join (tests.rs:175-214)
+  co_await CtrlerTester::check(ck, gidv());
+  auto addr1 = srvs(11, 12, 13);
+  auto addr2 = srvs(21, 22, 23);
+  {
+    GroupMap m = grp(1, addr1);
+    m.emplace(2, addr2);
+    co_await ck.join(std::move(m));
+  }
+  co_await CtrlerTester::check(ck, gidv(1, 2));
+
+  auto addr3 = srvs(31, 32, 33);
+  co_await ck.join(grp(3, addr3));
+  co_await CtrlerTester::check(ck, gidv(1, 2, 3));
+
+  {
+    Config cfx = co_await ck.query();
+    MT_ASSERT(cfx.groups[1] == addr1);
+    MT_ASSERT(cfx.groups[2] == addr2);
+    MT_ASSERT(cfx.groups[3] == addr3);
+  }
+
+  co_await ck.leave(gidv(1, 3));
+  co_await CtrlerTester::check(ck, gidv(2));
+  {
+    Config cfx = co_await ck.query();
+    MT_ASSERT(cfx.groups[2] == addr2);
+  }
+  co_await ck.leave(gidv(2));
+
+  // Concurrent multi leave/join (tests.rs:216-237)
+  constexpr uint64_t NPARA = 10;
+  std::vector<Gid> gids;
+  for (uint64_t i = 0; i < NPARA; i++) gids.push_back(1000 + i);
+  {
+    std::vector<simcore::TaskRef<void>> hs;
+    for (Gid gid : gids)
+      hs.push_back(sim->spawn(multi_concurrent_client(t.make_client(), gid)));
+    for (auto& h : hs) co_await h;
+  }
+  co_await CtrlerTester::check(ck, gids);
+
+  // Minimal transfers after multijoins (tests.rs:239-257)
+  Config c1 = co_await ck.query();
+  {
+    GroupMap m;
+    for (uint64_t i = 0; i < 5; i++) {
+      Gid gid = NPARA + 1 + i;
+      m.emplace(gid, srvs(gid + 1, gid + 2));
+    }
+    co_await ck.join(std::move(m));
+  }
+  Config c2 = co_await ck.query();
+  assert_minimal(c1, c2, gids, "multijoin");
+
+  // Minimal transfers after multileaves (tests.rs:259-278)
+  {
+    std::vector<Gid> l;
+    for (uint64_t i = 0; i < 5; i++) l.push_back(NPARA + 1 + i);
+    co_await ck.leave(std::move(l));
+  }
+  Config c3 = co_await ck.query();
+  for (Gid g : gids) {
+    for (size_t j = 0; j < N_SHARDS; j++)
+      MT_ASSERT(!(c2.shards[j] == g && c3.shards[j] != g));
+  }
+
+  // Same config on servers across leader kill (tests.rs:280-296)
+  {
+    auto leader = t.leader();
+    MT_ASSERT(leader.has_value());
+    Config c = co_await ck.query();
+    t.shutdown_server(*leader);
+    int attempts = 0;
+    while (!t.leader().has_value()) {  // wait for re-election
+      attempts++;
+      MT_ASSERT(attempts < 10);
+      co_await sim->sleep(1 * SEC);
+    }
+    Config cc = co_await ck.query();
+    MT_ASSERT(c == cc);
+  }
+  t.end();
+}
+
+}  // namespace
+
+MT_TEST(ctrler_basic_4a) {
+  Sim sim(seed);
+  MT_ASSERT(sim.run(basic_main(&sim)));
+}
+MT_TEST(ctrler_multi_4a) {
+  Sim sim(seed);
+  MT_ASSERT(sim.run(multi_main(&sim)));
+}
