@@ -83,6 +83,34 @@ struct RsmRequest {
       : client(c), seq(s), cmd(std::move(cmd_)) {}
 };
 
+// Shared propose-wait idiom: poll virtual time until the caller's apply
+// cursor reaches `index`, or leadership/term moved on (returns false — the
+// entry may have been superseded and the client must retry). `applied` is a
+// reference to the server's apply-channel counter; the caller's coroutine
+// frame keeps the server alive across the awaits.
+inline Task<bool> wait_applied(Sim* sim, Raft& raft, const uint64_t& applied,
+                               uint64_t index, uint64_t term) {
+  while (applied < index) {
+    if (raft.term() != term || !raft.is_leader()) co_return false;
+    co_await sim->sleep(5 * MSEC);
+  }
+  co_return true;
+}
+
+// Shared snapshot trigger (server.rs:34's max_raft_state watermark): when the
+// raft "state" file outgrows the limit, capture the service state via `save`
+// and hand it to raft for log truncation.
+template <class SaveFn>
+void snapshot_if_oversized(Sim* sim, Addr addr,
+                           const std::optional<size_t>& max_raft_state,
+                           Raft& raft, uint64_t index, SaveFn&& save) {
+  if (!max_raft_state) return;
+  if (sim->fs_size(addr, "state") < *max_raft_state) return;
+  Enc e;
+  save(e);
+  raft.snapshot(index, std::move(e.out));
+}
+
 // Server<S: State> (server.rs:18-71). S must provide:
 //   using Command / using Output            (copyable values)
 //   Output apply(const Command&)
@@ -130,11 +158,9 @@ class RsmServer : public std::enable_shared_from_this<RsmServer<S>> {
     S::enc_cmd(e, req.cmd);
     auto r = self->raft_->start(std::move(e.out));
     if (!r.ok) co_return Reply{Code::NotLeader, r.hint};
-    while (self->applied_ < r.index) {
-      if (self->raft_->term() != r.term || !self->raft_->is_leader())
-        co_return Reply{Code::Failed};
-      co_await self->sim_->sleep(5 * MSEC);
-    }
+    if (!co_await wait_applied(self->sim_, *self->raft_, self->applied_,
+                               r.index, r.term))
+      co_return Reply{Code::Failed};
     auto it = self->dup_.find(req.client);
     if (it != self->dup_.end() && it->second.seq >= req.seq)
       co_return Reply{Code::Ok, -1, it->second.out};
@@ -181,11 +207,8 @@ class RsmServer : public std::enable_shared_from_this<RsmServer<S>> {
   }
 
   void maybe_snapshot(uint64_t index) {
-    if (!max_raft_state_) return;
-    if (sim_->fs_size(addr_, "state") < *max_raft_state_) return;
-    Enc e;
-    save_snapshot(e);
-    raft_->snapshot(index, std::move(e.out));
+    snapshot_if_oversized(sim_, addr_, max_raft_state_, *raft_, index,
+                          [this](Enc& e) { save_snapshot(e); });
   }
 
   void save_snapshot(Enc& e) const {

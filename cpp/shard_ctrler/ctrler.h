@@ -134,6 +134,10 @@ struct ShardInfo {
       }
       case CtrlOp::Kind::Move: {
         if (op.shard >= N_SHARDS) return std::nullopt;  // reject, don't UB
+        // reject a move to a gid that never joined: downstream (shardkv)
+        // would try to pull from an owner with no servers and wedge
+        if (op.gid != 0 && !configs.back().groups.count(op.gid))
+          return std::nullopt;
         Config c = configs.back();
         c.num++;
         c.shards[op.shard] = op.gid;

@@ -71,8 +71,7 @@ class CtrlerTester {
     // every shard's owner must always be a live group, or 0 when none exist
     for (size_t s = 0; s < N_SHARDS; s++) {
       Gid g = c.shards[s];
-      bool ok = c.groups.empty() ? (g == 0 || c.groups.count(g))
-                                 : c.groups.count(g) > 0;
+      bool ok = c.groups.empty() ? g == 0 : c.groups.count(g) > 0;
       if (!ok) {
         std::fprintf(stderr, "check: shard %zu -> invalid group %llu\n", s,
                      (unsigned long long)g);

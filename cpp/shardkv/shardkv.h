@@ -1,0 +1,692 @@
+// shardkv — the Lab 4B multi-group sharded KV service (SURVEY.md §2 C9,
+// /root/reference/src/shardkv/):
+//   key2shard = first byte % N_SHARDS        (mod.rs:12-15, "do not change")
+//   Op::{Get, Put, Append}; Reply::{Get{value}, Ok, WrongGroup}  (msg.rs:3-15)
+//   ShardKvServer::new(ctrl_ck, servers, gid, me, max_raft_state)
+//                                            (server.rs:12-18, todo!())
+//   Clerk routes by config, retries on WrongGroup  (client.rs:16-25, todo!())
+//
+// The reference leaves the whole server/client as todo!() stubs; this is a
+// from-scratch design for the full lab including both challenges
+// (tests.rs:438-605):
+//
+//  * One Raft group per gid. The replicated state machine consumes a tagged
+//    command stream: client ops, config installs, shard installs, shard
+//    erases, and ack-dones. Everything that must survive crashes — current
+//    config, pending pulls, frozen outgoing shards, unacked installs — is
+//    replicated state, snapshotted together with the data.
+//  * Data and dup-tables are PER SHARD so they migrate with the shard: a
+//    clerk retry that lands on the shard's new owner still deduplicates
+//    (the record traveled inside InstallShard).
+//  * Config changes advance one step at a time (num+1) and only when the
+//    current config's pulls are complete; that gates chained migrations
+//    (the at-config-N owner has the data before it freezes the shard for
+//    the config-N+1 owner).
+//  * Serving is per shard: owned && not mid-pull. A shard received early in
+//    a partially-completed migration serves immediately (challenge 2,
+//    tests.rs:499-605); unaffected shards never stop serving.
+//  * Losing a shard freezes it into `outgoing[{config,shard}]`; the new
+//    owner pulls it, commits InstallShard, then acks until the source
+//    commits EraseShard (challenge 1 storage bound, tests.rs:477-488). Both
+//    sides are idempotent, so every RPC may be retried blindly.
+#pragma once
+
+#include <array>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "../shard_ctrler/ctrler.h"
+
+namespace shardkv {
+
+using raftcore::ApplyMsg;
+using raftcore::Bytes;
+using raftcore::Dec;
+using raftcore::Enc;
+using raftcore::Raft;
+using shard_ctrler::Config;
+using shard_ctrler::CtrlerClerk;
+using shard_ctrler::Gid;
+using shard_ctrler::N_SHARDS;
+using simcore::Addr;
+using simcore::Channel;
+using simcore::MSEC;
+using simcore::Sim;
+using simcore::Task;
+
+// mod.rs:12-15 — "please do not change it"
+inline size_t key2shard(const std::string& key) {
+  return size_t(key.empty() ? 0 : uint8_t(key[0])) % N_SHARDS;
+}
+
+// msg.rs:3-8
+struct Op {
+  enum class Kind : uint8_t { Get, Put, Append } kind = Kind::Get;
+  std::string key;
+  std::string value;
+  Op() = default;  // non-aggregate (gcc-12 coroutine relocation, see rsm.h)
+  Op(Kind k, std::string key_, std::string value_)
+      : kind(k), key(std::move(key_)), value(std::move(value_)) {}
+};
+
+// msg.rs:10-15 — Reply::{Get{value}, Ok, WrongGroup}; NotLeader/Failed drive
+// clerk retry like the kvraft codes (they never commit through raft).
+enum class Code : uint8_t { Ok, WrongGroup, NotLeader, Failed };
+
+struct KvReply {
+  Code code = Code::Failed;
+  int hint = -1;
+  std::string value;  // Get result
+  KvReply() = default;
+  KvReply(Code c, int h = -1, std::string v = {})
+      : code(c), hint(h), value(std::move(v)) {}
+};
+
+struct KvRequest {
+  uint64_t client = 0;
+  uint64_t seq = 0;
+  Op op;
+  using Reply = KvReply;
+  KvRequest() = default;
+  KvRequest(uint64_t c, uint64_t s, Op o)
+      : client(c), seq(s), op(std::move(o)) {}
+};
+
+// One shard's migratable payload: data + its dup table (so exactly-once
+// survives the move).
+struct ShardData {
+  std::map<std::string, std::string> kv;
+  struct DupRec {
+    uint64_t seq = 0;
+    std::string value;  // cached Get output
+    bool has_value = false;
+  };
+  std::map<uint64_t, DupRec> dup;
+  ShardData() = default;
+
+  void enc(Enc& e) const {
+    e.u64(kv.size());
+    for (auto& [k, v] : kv) {
+      e.str(k);
+      e.str(v);
+    }
+    e.u64(dup.size());
+    for (auto& [c, r] : dup) {
+      e.u64(c);
+      e.u64(r.seq);
+      e.u64(r.has_value ? 1 : 0);
+      e.str(r.value);
+    }
+  }
+  static ShardData dec(Dec& d) {
+    ShardData s;
+    uint64_t n = d.u64();
+    for (uint64_t i = 0; i < n; i++) {
+      auto k = d.str();
+      s.kv[k] = d.str();
+    }
+    uint64_t m = d.u64();
+    for (uint64_t i = 0; i < m; i++) {
+      auto& r = s.dup[d.u64()];
+      r.seq = d.u64();
+      r.has_value = d.u64() != 0;
+      r.value = d.str();
+    }
+    return s;
+  }
+};
+
+// Inter-group migration RPCs (both leader-served, both idempotent).
+struct PullShardArgs {
+  uint64_t config_num = 0;
+  uint64_t shard = 0;
+  struct Reply {
+    // Ok: payload attached. NotReady: source hasn't reached config_num yet.
+    // Gone: already erased (duplicate pull after ack — ignore).
+    // NotLeader: try another server.
+    enum class Code : uint8_t { Ok, NotReady, Gone, NotLeader } code =
+        Code::NotLeader;
+    Bytes data;  // encoded ShardData
+    Reply() = default;
+  };
+  PullShardArgs() = default;
+  PullShardArgs(uint64_t c, uint64_t s) : config_num(c), shard(s) {}
+};
+
+struct AckPullArgs {
+  uint64_t config_num = 0;
+  uint64_t shard = 0;
+  struct Reply {
+    bool ok = false;  // erased (or was already gone)
+    Reply() = default;
+  };
+  AckPullArgs() = default;
+  AckPullArgs(uint64_t c, uint64_t s) : config_num(c), shard(s) {}
+};
+
+// ------------------------------------------------------------------- server
+class ShardKvServer : public std::enable_shared_from_this<ShardKvServer> {
+  // Raft log command tags.
+  enum class Cmd : uint8_t { Client, Config, Install, Erase, AckDone };
+
+  struct PullInfo {
+    uint64_t config_num = 0;
+    Gid src_gid = 0;
+    std::vector<Addr> src_servers;
+  };
+
+ public:
+  static Task<std::shared_ptr<ShardKvServer>> boot(
+      Sim* sim, std::shared_ptr<CtrlerClerk> ctrl_ck, std::vector<Addr> servers,
+      Gid gid, size_t me, std::optional<size_t> max_raft_state) {
+    auto self = std::shared_ptr<ShardKvServer>(
+        new ShardKvServer(sim, std::move(ctrl_ck), servers, gid, me,
+                          max_raft_state));
+    self->raft_ =
+        co_await sim->spawn(Raft::boot(sim, servers, me, self->apply_ch_));
+    sim->add_rpc_handler<KvRequest>(
+        [self](KvRequest req) { return handle_client(self, std::move(req)); });
+    sim->add_rpc_handler<PullShardArgs>([self](PullShardArgs a) {
+      return handle_pull(self, a);
+    });
+    sim->add_rpc_handler<AckPullArgs>([self](AckPullArgs a) {
+      return handle_ack(self, a);
+    });
+    sim->spawn(applier(self));
+    sim->spawn(config_poller(self));
+    sim->spawn(migrator(self));
+    co_return self;
+  }
+
+  uint64_t term() const { return raft_->term(); }
+  bool is_leader() const { return raft_->is_leader(); }
+
+ private:
+  ShardKvServer(Sim* sim, std::shared_ptr<CtrlerClerk> ctrl_ck,
+                std::vector<Addr> servers, Gid gid, size_t me,
+                std::optional<size_t> mrs)
+      : sim_(sim), ctrl_ck_(std::move(ctrl_ck)), addr_(servers[me]), gid_(gid),
+        max_raft_state_(mrs) {}
+
+  bool serving(size_t shard) const {
+    return config_.shards[shard] == gid_ && !pull_pending_.count(shard);
+  }
+
+  // ---- client path (server.rs:52-56 analogue, WrongGroup decided at apply)
+  static Task<KvReply> handle_client(std::shared_ptr<ShardKvServer> self,
+                                     KvRequest req) {
+    // Fast reject so clerks don't burn 500ms on a non-serving group — but
+    // only on the leader: a follower's config may lag, and a spurious
+    // WrongGroup from a stale follower would send the clerk back to the
+    // ctrler in a loop. Followers answer NotLeader (via start()) instead.
+    size_t shard = key2shard(req.op.key);
+    if (self->raft_->is_leader() && !self->serving(shard))
+      co_return KvReply{Code::WrongGroup};
+    Enc e;
+    e.u64(uint64_t(Cmd::Client));
+    e.u64(req.client);
+    e.u64(req.seq);
+    e.u64(uint64_t(req.op.kind));
+    e.str(req.op.key);
+    e.str(req.op.value);
+    auto r = self->raft_->start(std::move(e.out));
+    if (!r.ok) co_return KvReply{Code::NotLeader, r.hint};
+    if (!co_await kvraft::wait_applied(self->sim_, *self->raft_,
+                                       self->applied_, r.index, r.term))
+      co_return KvReply{Code::Failed};
+    auto it = self->results_.find(r.index);
+    if (it != self->results_.end() && it->second.client == req.client &&
+        it->second.seq == req.seq) {
+      co_return it->second.reply;
+    }
+    co_return KvReply{Code::Failed};  // different entry won our index
+  }
+
+  // ---- migration read side: serve a frozen shard to its new owner
+  static Task<PullShardArgs::Reply> handle_pull(
+      std::shared_ptr<ShardKvServer> self, PullShardArgs a) {
+    PullShardArgs::Reply rep;
+    if (!self->raft_->is_leader()) {
+      rep.code = PullShardArgs::Reply::Code::NotLeader;
+      co_return rep;
+    }
+    if (self->config_.num < a.config_num) {
+      rep.code = PullShardArgs::Reply::Code::NotReady;
+      co_return rep;
+    }
+    auto it = self->outgoing_.find({a.config_num, a.shard});
+    if (it == self->outgoing_.end()) {
+      rep.code = PullShardArgs::Reply::Code::Gone;
+      co_return rep;
+    }
+    Enc e;
+    it->second.enc(e);
+    rep.code = PullShardArgs::Reply::Code::Ok;
+    rep.data = std::move(e.out);
+    co_return rep;
+  }
+
+  // ---- migration GC side: new owner confirms install; we erase (challenge 1)
+  static Task<AckPullArgs::Reply> handle_ack(std::shared_ptr<ShardKvServer> self,
+                                             AckPullArgs a) {
+    AckPullArgs::Reply rep;
+    if (!self->raft_->is_leader()) co_return rep;  // ok=false → retry
+    // Same staleness guard as handle_pull: a freshly elected leader that has
+    // not yet applied the config-N freeze would otherwise report "already
+    // erased" for a shard it still holds, and the puller would stop acking —
+    // leaking the frozen shard forever (challenge-1 storage bound).
+    if (self->config_.num < a.config_num) co_return rep;
+    if (!self->outgoing_.count({a.config_num, a.shard})) {
+      rep.ok = true;  // already erased — idempotent success
+      co_return rep;
+    }
+    Enc e;
+    e.u64(uint64_t(Cmd::Erase));
+    e.u64(a.config_num);
+    e.u64(a.shard);
+    auto r = self->raft_->start(std::move(e.out));
+    if (!r.ok) co_return rep;
+    if (!co_await kvraft::wait_applied(self->sim_, *self->raft_,
+                                       self->applied_, r.index, r.term))
+      co_return rep;
+    rep.ok = !self->outgoing_.count({a.config_num, a.shard});
+    co_return rep;
+  }
+
+  // ---- config poller: fetch config num+1 when the current migration is done
+  // (server.rs:12-14 — the ctor-provided ctrl clerk exists for this loop)
+  static Task<void> config_poller(std::shared_ptr<ShardKvServer> self) {
+    for (;;) {
+      co_await self->sim_->sleep(50 * MSEC);
+      if (!self->raft_->is_leader()) continue;
+      if (!self->pull_pending_.empty()) continue;  // finish migration first
+      uint64_t want = self->config_.num + 1;
+      Config c = co_await self->ctrl_ck_->query_at(want);
+      if (c.num != want) continue;  // no newer config yet
+      if (self->config_.num + 1 != want || !self->pull_pending_.empty())
+        continue;  // state moved while we awaited the query
+      Enc e;
+      e.u64(uint64_t(Cmd::Config));
+      Config::enc(e, c);
+      self->raft_->start(std::move(e.out));
+    }
+  }
+
+  // ---- migration write side: pull pending shards, then ack installs.
+  // One task per shard per round, so a dead source (challenge 2: pulls that
+  // can never finish) only costs its own task's timeouts, not a serial stall
+  // of every other shard's migration and GC.
+  static Task<void> pull_one(std::shared_ptr<ShardKvServer> self,
+                             uint64_t shard, PullInfo info) {
+    // still pending for this config? (a snapshot/commit may have landed)
+    auto cur = self->pull_pending_.find(shard);
+    if (cur == self->pull_pending_.end() ||
+        cur->second.config_num != info.config_num)
+      co_return;
+    for (size_t i = 0; i < info.src_servers.size(); i++) {
+      auto rep = co_await self->sim_->call_timeout(
+          info.src_servers[i], PullShardArgs{info.config_num, shard},
+          200 * MSEC);
+      if (!rep) continue;
+      using C = PullShardArgs::Reply::Code;
+      if (rep->code == C::Ok) {
+        Enc e;
+        e.u64(uint64_t(Cmd::Install));
+        e.u64(info.config_num);
+        e.u64(shard);
+        e.bytes(rep->data);
+        auto r = self->raft_->start(std::move(e.out));
+        // wait for the install to land so the next migrator round doesn't
+        // re-pull the whole payload and double-log the Install
+        if (r.ok)
+          co_await kvraft::wait_applied(self->sim_, *self->raft_,
+                                        self->applied_, r.index, r.term);
+        co_return;
+      }
+      if (rep->code == C::Gone) co_return;     // install already happened
+      if (rep->code == C::NotReady) co_return;  // source lags; retry later
+      // NotLeader → try next server
+    }
+  }
+
+  static Task<void> ack_one(std::shared_ptr<ShardKvServer> self,
+                            uint64_t cfg_num, uint64_t shard, PullInfo src) {
+    std::pair<uint64_t, uint64_t> key(cfg_num, shard);
+    if (!self->need_ack_.count(key)) co_return;
+    for (size_t i = 0; i < src.src_servers.size(); i++) {
+      auto rep = co_await self->sim_->call_timeout(
+          src.src_servers[i], AckPullArgs{cfg_num, shard}, 200 * MSEC);
+      if (rep && rep->ok) {
+        Enc e;
+        e.u64(uint64_t(Cmd::AckDone));
+        e.u64(cfg_num);
+        e.u64(shard);
+        auto r = self->raft_->start(std::move(e.out));
+        if (r.ok)  // same: one AckDone per completed ack, not one per round
+          co_await kvraft::wait_applied(self->sim_, *self->raft_,
+                                        self->applied_, r.index, r.term);
+        co_return;
+      }
+    }
+  }
+
+  static Task<void> migrator(std::shared_ptr<ShardKvServer> self) {
+    for (;;) {
+      co_await self->sim_->sleep(50 * MSEC);
+      if (!self->raft_->is_leader()) continue;
+      std::vector<simcore::TaskRef<void>> round;
+      for (auto& [shard, info] : self->pull_pending_)
+        round.push_back(self->sim_->spawn(pull_one(self, shard, info)));
+      for (auto& [key, src] : self->need_ack_)
+        round.push_back(
+            self->sim_->spawn(ack_one(self, key.first, key.second, src)));
+      for (auto& t : round) co_await t;
+    }
+  }
+
+  // ---- the replicated state machine
+  static Task<void> applier(std::shared_ptr<ShardKvServer> self) {
+    for (;;) {
+      auto m = co_await self->apply_ch_.recv();
+      if (!m) break;
+      if (m->is_snapshot) {
+        if (self->raft_->cond_install_snapshot(m->term, m->index, m->data)) {
+          Dec d(m->data);
+          self->load_snapshot(d);
+          self->applied_ = m->index;
+          self->results_.clear();
+        }
+        continue;
+      }
+      Dec d(m->data);
+      self->apply_cmd(d, m->index);
+      self->applied_ = m->index;
+      // bound the volatile result window (handlers read their own index fast)
+      while (!self->results_.empty() &&
+             self->results_.begin()->first + 512 < m->index)
+        self->results_.erase(self->results_.begin());
+      self->maybe_snapshot(m->index);
+    }
+  }
+
+  void apply_cmd(Dec& d, uint64_t index) {
+    switch (Cmd(d.u64())) {
+      case Cmd::Client: {
+        uint64_t client = d.u64();
+        uint64_t seq = d.u64();
+        Op op;
+        op.kind = Op::Kind(d.u64());
+        op.key = d.str();
+        op.value = d.str();
+        size_t shard = key2shard(op.key);
+        Result res;
+        res.client = client;
+        res.seq = seq;
+        if (!serving(shard)) {
+          res.reply = KvReply{Code::WrongGroup};
+        } else {
+          auto& sd = shards_[shard];
+          auto& rec = sd.dup[client];
+          if (seq > rec.seq) {  // first time: apply
+            rec.seq = seq;
+            rec.has_value = false;
+            rec.value.clear();
+            switch (op.kind) {
+              case Op::Kind::Get: {
+                auto it = sd.kv.find(op.key);
+                rec.has_value = it != sd.kv.end();
+                if (rec.has_value) rec.value = it->second;
+                break;
+              }
+              case Op::Kind::Put:
+                sd.kv[op.key] = std::move(op.value);
+                break;
+              case Op::Kind::Append:
+                sd.kv[op.key] += op.value;
+                break;
+            }
+          }
+          // duplicate (seq <= rec.seq): serve the cached output
+          res.reply = KvReply{Code::Ok, -1, rec.value};
+        }
+        results_[index] = std::move(res);
+        break;
+      }
+      case Cmd::Config: {
+        Config c = Config::dec(d);
+        if (c.num != config_.num + 1) break;  // stale/duplicate proposal
+        Config old = std::move(config_);
+        config_ = std::move(c);
+        for (size_t s = 0; s < N_SHARDS; s++) {
+          bool was = old.shards[s] == gid_;
+          bool now = config_.shards[s] == gid_;
+          auto src_it = old.groups.find(old.shards[s]);
+          bool has_src = src_it != old.groups.end() && !src_it->second.empty();
+          if (now && !was && old.shards[s] != 0 && has_src) {
+            PullInfo pi;
+            pi.config_num = config_.num;
+            pi.src_gid = old.shards[s];
+            pi.src_servers = src_it->second;
+            pull_pending_[s] = std::move(pi);
+          } else if (was && !now && config_.shards[s] != 0) {
+            outgoing_[{config_.num, s}] = std::move(shards_[s]);
+            shards_[s] = ShardData{};
+          } else if (was && !now) {
+            // handed to gid 0 = every group left: there is no future puller,
+            // so freezing would leak the shard forever and a later joiner
+            // would diverge from us. Retire the data — all groups then agree
+            // the shard restarts empty (config-0 semantics).
+            shards_[s] = ShardData{};
+          }
+        }
+        break;
+      }
+      case Cmd::Install: {
+        uint64_t cfg_num = d.u64();
+        uint64_t shard = d.u64();
+        Bytes data = d.bytes();
+        auto it = pull_pending_.find(shard);
+        if (it == pull_pending_.end() || it->second.config_num != cfg_num)
+          break;  // duplicate install
+        Dec sd(data);
+        shards_[shard] = ShardData::dec(sd);
+        PullInfo src = std::move(it->second);
+        pull_pending_.erase(it);
+        need_ack_[{cfg_num, shard}] = std::move(src);
+        break;
+      }
+      case Cmd::Erase: {
+        uint64_t cfg_num = d.u64();
+        uint64_t shard = d.u64();
+        outgoing_.erase({cfg_num, shard});
+        break;
+      }
+      case Cmd::AckDone: {
+        uint64_t cfg_num = d.u64();
+        uint64_t shard = d.u64();
+        need_ack_.erase({cfg_num, shard});
+        break;
+      }
+    }
+  }
+
+  void maybe_snapshot(uint64_t index) {
+    kvraft::snapshot_if_oversized(sim_, addr_, max_raft_state_, *raft_, index,
+                                  [this](Enc& e) { save_snapshot(e); });
+  }
+
+  void save_snapshot(Enc& e) const {
+    Config::enc(e, config_);
+    for (auto& sd : shards_) sd.enc(e);
+    e.u64(pull_pending_.size());
+    for (auto& [shard, pi] : pull_pending_) {
+      e.u64(shard);
+      e.u64(pi.config_num);
+      e.u64(pi.src_gid);
+      e.u64(pi.src_servers.size());
+      for (auto a : pi.src_servers) e.u64(a);
+    }
+    e.u64(need_ack_.size());
+    for (auto& [key, pi] : need_ack_) {
+      e.u64(key.first);
+      e.u64(key.second);
+      e.u64(pi.src_gid);
+      e.u64(pi.src_servers.size());
+      for (auto a : pi.src_servers) e.u64(a);
+    }
+    e.u64(outgoing_.size());
+    for (auto& [key, sd] : outgoing_) {
+      e.u64(key.first);
+      e.u64(key.second);
+      sd.enc(e);
+    }
+  }
+  void load_snapshot(Dec& d) {
+    config_ = Config::dec(d);
+    for (auto& sd : shards_) sd = ShardData::dec(d);
+    pull_pending_.clear();
+    uint64_t np = d.u64();
+    for (uint64_t i = 0; i < np; i++) {
+      uint64_t shard = d.u64();
+      auto& pi = pull_pending_[shard];
+      pi.config_num = d.u64();
+      pi.src_gid = d.u64();
+      uint64_t ns = d.u64();
+      for (uint64_t j = 0; j < ns; j++) pi.src_servers.push_back(Addr(d.u64()));
+    }
+    need_ack_.clear();
+    uint64_t na = d.u64();
+    for (uint64_t i = 0; i < na; i++) {
+      uint64_t cn = d.u64();
+      uint64_t shard = d.u64();
+      auto& pi = need_ack_[{cn, shard}];
+      pi.config_num = cn;  // keep snapshot-restored state == log-replayed state
+      pi.src_gid = d.u64();
+      uint64_t ns = d.u64();
+      for (uint64_t j = 0; j < ns; j++) pi.src_servers.push_back(Addr(d.u64()));
+    }
+    outgoing_.clear();
+    uint64_t no = d.u64();
+    for (uint64_t i = 0; i < no; i++) {
+      uint64_t cn = d.u64();
+      uint64_t shard = d.u64();
+      outgoing_[{cn, shard}] = ShardData::dec(d);
+    }
+  }
+
+  struct Result {
+    uint64_t client = 0;
+    uint64_t seq = 0;
+    KvReply reply;
+  };
+
+  Sim* sim_;
+  std::shared_ptr<CtrlerClerk> ctrl_ck_;
+  Addr addr_;
+  Gid gid_;
+  std::optional<size_t> max_raft_state_;
+  Channel<ApplyMsg> apply_ch_;
+  std::shared_ptr<Raft> raft_;
+  uint64_t applied_ = 0;
+
+  // replicated state (snapshotted)
+  Config config_;  // num 0: nothing owned
+  std::array<ShardData, N_SHARDS> shards_;
+  std::map<uint64_t, PullInfo> pull_pending_;  // shard -> source
+  std::map<std::pair<uint64_t, uint64_t>, PullInfo> need_ack_;
+  std::map<std::pair<uint64_t, uint64_t>, ShardData> outgoing_;
+
+  // volatile
+  std::map<uint64_t, Result> results_;  // raft index -> applied result
+};
+
+// ------------------------------------------------------------------- client
+// client.rs:4-26 — owns a ctrler clerk, routes by cached config, re-queries
+// on WrongGroup, retries forever.
+// CONTRACT: one outstanding op at a time per ShardClerk (same as ClerkCore,
+// rsm.h): seq advances per op, and the per-shard dup tables treat any
+// lower-seq arrival as an already-answered duplicate — concurrent ops on one
+// clerk could silently swallow the older one. Tests honor this (each
+// concurrent task owns its own clerk).
+class ShardClerk : public std::enable_shared_from_this<ShardClerk> {
+ public:
+  ShardClerk(Sim* sim, std::vector<Addr> ctrler_addrs, uint64_t kv_id,
+             uint64_t ctrl_id)
+      : sim_(sim),
+        ctrl_ck_(std::make_shared<CtrlerClerk>(sim, std::move(ctrler_addrs),
+                                               ctrl_id)),
+        id_(kv_id) {}
+
+  // The verbs hand the coroutine a shared self: a spawned op must keep the
+  // clerk alive even if the task that created it is aborted mid-await (the
+  // reference gets this for free from Rust ownership; C++ member coroutines
+  // capture a raw `this`).
+  Task<std::string> get(std::string key) {
+    return call(shared_from_this(), Op{Op::Kind::Get, std::move(key), {}});
+  }
+  Task<std::string> put(std::string key, std::string value) {
+    return call(shared_from_this(),
+                Op{Op::Kind::Put, std::move(key), std::move(value)});
+  }
+  Task<std::string> append(std::string key, std::string value) {
+    return call(shared_from_this(),
+                Op{Op::Kind::Append, std::move(key), std::move(value)});
+  }
+  uint64_t id() const { return id_; }
+
+ private:
+  static Task<std::string> call(std::shared_ptr<ShardClerk> self, Op op) {
+    uint64_t seq = ++self->seq_;
+    size_t shard = key2shard(op.key);
+    for (;;) {
+      if (self->config_.num == 0)
+        self->config_ = co_await self->ctrl_ck_->query();
+      Gid g = self->config_.shards[shard];
+      auto git = self->config_.groups.find(g);
+      if (g != 0 && git != self->config_.groups.end() &&
+          !git->second.empty()) {
+        // copy, not reference: this loop reassigns config_ (bottom of the
+        // outer loop) while iterating — and a contract-violating caller
+        // running sibling ops must corrupt results, not memory
+        std::vector<Addr> servers = git->second;
+        size_t i = self->leader_[g] % servers.size();
+        bool wrong_group = false;
+        for (size_t tries = 0; tries < servers.size() + 2 && !wrong_group;
+             tries++) {
+          auto reply = co_await self->sim_->call_timeout(
+              servers[i], KvRequest{self->id_, seq, op}, 500 * MSEC);
+          if (reply && reply->code == Code::Ok) {
+            self->leader_[g] = i;
+            co_return reply->value;
+          }
+          if (reply && reply->code == Code::WrongGroup) {
+            // rotate the cached leader before re-querying: a deposed leader
+            // with a stale config would otherwise answer WrongGroup forever
+            // while the group's live majority is never tried
+            self->leader_[g] = (i + 1) % servers.size();
+            wrong_group = true;
+          } else if (reply && reply->code == Code::NotLeader &&
+                     reply->hint >= 0 && size_t(reply->hint) < servers.size() &&
+                     size_t(reply->hint) != i) {
+            i = size_t(reply->hint);
+          } else {
+            i = (i + 1) % servers.size();
+          }
+        }
+      }
+      co_await self->sim_->sleep(100 * MSEC);
+      self->config_ = co_await self->ctrl_ck_->query();  // refresh, re-route
+    }
+  }
+
+  Sim* sim_;
+  std::shared_ptr<CtrlerClerk> ctrl_ck_;
+  uint64_t id_;
+  uint64_t seq_ = 0;
+  Config config_;
+  std::map<Gid, size_t> leader_;  // per-group leader hint
+};
+
+}  // namespace shardkv

@@ -1,0 +1,230 @@
+// ShardKV tester — the C++ analogue of the reference's 4B harness
+// (SURVEY.md §2 C16, /root/reference/src/shardkv/tester.rs):
+//   * topology: 3 ctrler servers at 0.0.1.i + 3 groups (gid 100/101/102) × n
+//     servers at 0.1.g.j (tester.rs:47-70)
+//   * group-level start/shutdown (tester.rs:136-172)
+//   * ctrl-plane join/leave via a ctrler clerk (tester.rs:174-199)
+//   * query_shards_of(group) (tester.rs:202-206)
+//   * storage checkers: check_logs (state ≤ 8×limit; snapshot empty when no
+//     limit, tester.rs:91-111) and total_size for the deletion challenge
+//     (tester.rs:113-123)
+//   * deterministic rand_string values from the sim RNG (tester.rs:264-270)
+#pragma once
+
+#include <cstdio>
+#include <memory>
+#include <set>
+
+#include "../tests/framework.h"
+#include "shardkv.h"
+
+namespace shardkv {
+
+using simcore::make_addr;
+using simcore::SEC;
+using simcore::TaskRef;
+
+class ShardKvTester {
+ public:
+  static constexpr int N_GROUPS = 3;
+
+  ShardKvTester(Sim* sim, int n, bool unreliable,
+                std::optional<size_t> max_raft_state)
+      : sim_(sim), n_(n), max_raft_state_(max_raft_state) {
+    if (unreliable) {  // tester.rs:40-45
+      auto& cfg = sim_->net_config();
+      cfg.packet_loss_rate = 0.1;
+      cfg.send_latency_min = 1 * simcore::MSEC;
+      cfg.send_latency_max = 27 * simcore::MSEC;
+    }
+    for (int i = 0; i < 3; i++) ctrler_addrs_.push_back(make_addr(0, 0, 1, i));
+    for (int g = 0; g < N_GROUPS; g++) {
+      Group grp;
+      grp.gid = 100 + g;  // tester.rs:64
+      for (int j = 0; j < n; j++)
+        grp.addrs.push_back(make_addr(0, 1, g, j));  // tester.rs:66
+      grp.servers.resize(n);
+      groups_.push_back(std::move(grp));
+    }
+    start_time_ = sim->now();
+  }
+
+  Task<void> init() {
+    for (size_t i = 0; i < ctrler_addrs_.size(); i++) {
+      ctrlers_.push_back(co_await sim_->spawn(
+          ctrler_addrs_[i],
+          shard_ctrler::ShardCtrler::boot(sim_, ctrler_addrs_, i,
+                                          max_raft_state_)));
+    }
+    ctrler_ck_ = std::make_shared<CtrlerClerk>(sim_, ctrler_addrs_, next_id_++);
+    for (int g = 0; g < N_GROUPS; g++)
+      for (int i = 0; i < n_; i++) co_await sim_->spawn(start_server(g, i));
+  }
+
+  Sim* sim() { return sim_; }
+  int n() const { return n_; }
+  Gid gid_of(int group) const { return groups_[group].gid; }
+
+  // ---- server lifecycle (tester.rs:136-172)
+  Task<void> start_server(int group, int i) {
+    auto& g = groups_[group];
+    auto ctrl_ck =
+        std::make_shared<CtrlerClerk>(sim_, ctrler_addrs_, next_id_++);
+    g.servers[i] = co_await sim_->spawn(
+        g.addrs[i], ShardKvServer::boot(sim_, ctrl_ck, g.addrs, g.gid, i,
+                                        max_raft_state_));
+  }
+  void shutdown_server(int group, int i) {
+    sim_->kill(groups_[group].addrs[i]);
+    groups_[group].servers[i] = nullptr;
+  }
+  Task<void> start_group(int group) {
+    for (int i = 0; i < n_; i++) co_await sim_->spawn(start_server(group, i));
+  }
+  void shutdown_group(int group) {
+    for (int i = 0; i < n_; i++) shutdown_server(group, i);
+  }
+
+  // ---- ctrl plane (tester.rs:174-199)
+  Task<void> join(int group) { return joins({group}); }
+  Task<void> joins(std::vector<int> groups) {
+    std::map<Gid, std::vector<Addr>> m;
+    for (int g : groups) m[groups_[g].gid] = groups_[g].addrs;
+    co_await sim_->spawn(ctrler_ck_->join(std::move(m)));
+  }
+  Task<void> leave(int group) { return leaves({group}); }
+  Task<void> leaves(std::vector<int> groups) {
+    std::vector<Gid> gids;
+    for (int g : groups) gids.push_back(groups_[g].gid);
+    co_await sim_->spawn(ctrler_ck_->leave(std::move(gids)));
+  }
+
+  // tester.rs:202-206
+  Task<std::set<size_t>> query_shards_of(int group) {
+    Config c = co_await sim_->spawn(ctrler_ck_->query());
+    std::set<size_t> owned;
+    for (size_t s = 0; s < N_SHARDS; s++)
+      if (c.shards[s] == groups_[group].gid) owned.insert(s);
+    co_return owned;
+  }
+
+  // ---- storage checkers (tester.rs:91-123)
+  void check_logs() const {
+    for (auto& g : groups_) {
+      for (Addr a : g.addrs) {
+        size_t state_size = sim_->fs_size(a, "state");
+        size_t snap_size = sim_->fs_size(a, "snapshot");
+        if (max_raft_state_) {
+          if (state_size > 8 * *max_raft_state_) {
+            std::fprintf(stderr, "raft state size %zu exceeds limit %zu\n",
+                         state_size, 8 * *max_raft_state_);
+            std::abort();
+          }
+        } else if (snap_size != 0) {
+          std::fprintf(stderr,
+                       "max_raft_state is None, but snapshot is non-empty\n");
+          std::abort();
+        }
+      }
+    }
+  }
+  size_t total_size() const {
+    size_t size = 0;
+    for (auto& g : groups_)
+      for (Addr a : g.addrs)
+        size += sim_->fs_size(a, "state") + sim_->fs_size(a, "snapshot");
+    return size;
+  }
+
+  // ---- clerks (tester.rs:131-133, 234-261)
+  class Clerk {
+   public:
+    Clerk(Sim* sim, Addr addr, std::shared_ptr<ShardClerk> ck)
+        : sim_(sim), addr_(addr), ck_(std::move(ck)) {}
+
+    Task<void> put(std::string k, std::string v) {
+      co_await sim_->spawn(addr_, ck_->put(std::move(k), std::move(v)));
+    }
+    Task<void> append(std::string k, std::string v) {
+      co_await sim_->spawn(addr_, ck_->append(std::move(k), std::move(v)));
+    }
+    Task<std::string> get(std::string k) {
+      co_return co_await sim_->spawn(addr_, ck_->get(std::move(k)));
+    }
+    Task<void> check(std::string k, std::string expected) {  // tester.rs:241-244
+      auto v = co_await get(k);
+      if (v != expected) {
+        std::fprintf(stderr, "check failed: key=%s got %.60s want %.60s\n",
+                     k.c_str(), v.c_str(), expected.c_str());
+        std::abort();
+      }
+    }
+
+    using Kvs = std::vector<std::pair<std::string, std::string>>;
+    Task<void> put_kvs(const Kvs& kvs) {  // tester.rs:235-239
+      for (auto& [k, v] : kvs) co_await put(k, v);
+    }
+    Task<void> check_kvs(const Kvs& kvs) {  // tester.rs:246-251
+      for (auto& [k, v] : kvs) co_await check(k, v);
+    }
+    // tester.rs:253-261: verify, then append a fresh random suffix
+    Task<void> check_append_kvs(Kvs& kvs, size_t len) {
+      for (auto& [k, v] : kvs) {
+        co_await check(k, v);
+        auto s = rand_string(sim_, len);
+        v += s;
+        co_await append(k, s);
+      }
+    }
+
+   private:
+    Sim* sim_;
+    Addr addr_;
+    std::shared_ptr<ShardClerk> ck_;
+  };
+
+  Clerk make_client() {  // tester.rs:131-133
+    uint64_t kv_id = next_id_++;
+    uint64_t ctrl_id = next_id_++;
+    Addr addr = make_addr(0, 0, 3, next_clerk_addr_++);
+    return Clerk(sim_, addr,
+                 std::make_shared<ShardClerk>(sim_, ctrler_addrs_, kv_id,
+                                              ctrl_id));
+  }
+
+  // tester.rs:264-270 — deterministic alphanumeric values from the sim RNG
+  static std::string rand_string(Sim* sim, size_t len) {
+    static const char cs[] =
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789";
+    std::string s;
+    for (size_t i = 0; i < len; i++) s += cs[sim->rand_range(0, 62)];
+    return s;
+  }
+
+  void end() const {  // tester.rs:212-224
+    std::printf("  ... elapsed %.2fs(virt) peers %d rpcs %llu\n",
+                (sim_->now() - start_time_) / 1e9, n_,
+                (unsigned long long)(sim_->msg_count() / 2));
+  }
+
+ private:
+  struct Group {
+    Gid gid = 0;
+    std::vector<Addr> addrs;
+    std::vector<std::shared_ptr<ShardKvServer>> servers;
+    Group() = default;
+  };
+
+  Sim* sim_;
+  int n_;
+  std::optional<size_t> max_raft_state_;
+  uint64_t start_time_;
+  std::vector<Addr> ctrler_addrs_;
+  std::vector<std::shared_ptr<shard_ctrler::ShardCtrler>> ctrlers_;
+  std::shared_ptr<CtrlerClerk> ctrler_ck_;
+  std::vector<Group> groups_;
+  uint64_t next_id_ = 0;
+  unsigned next_clerk_addr_ = 1;
+};
+
+}  // namespace shardkv
