@@ -7,6 +7,7 @@
 #include <string>
 
 #include "../kvraft/kv_tester.h"
+#include "../kvraft/linearize.h"
 #include "framework.h"
 
 using namespace kvraft;
@@ -360,4 +361,151 @@ MT_TEST(kv_snapshot_unreliable_recover_3b) {
 }
 MT_TEST(kv_snapshot_unreliable_recover_concurrent_partition_3b) {
   run_generic(seed, 5, true, true, true, 1000);
+}
+
+// ------------------------------------------- linearizability (tests.rs:386-390,
+// 524-528 — commented out upstream; implemented here per SURVEY.md §4.2/§7)
+namespace {
+
+// checker self-validation: known-good and known-bad histories
+void linearize_checker_unit(uint64_t) {
+  using kvraft::HistOp;
+  auto op = [](uint64_t inv, uint64_t ret, Op::Kind k, std::string key,
+               std::string in, std::string out) {
+    HistOp h;
+    h.invoke = inv;
+    h.ret = ret;
+    h.kind = k;
+    h.key = std::move(key);
+    h.input = std::move(in);
+    h.output = std::move(out);
+    return h;
+  };
+  // sequential read-write-read: linearizable
+  std::vector<HistOp> good{
+      op(0, 5, Op::Kind::Get, "k", "", ""),
+      op(6, 10, Op::Kind::Put, "k", "a", ""),
+      op(11, 15, Op::Kind::Get, "k", "", "a"),
+      op(16, 20, Op::Kind::Append, "k", "b", ""),
+      op(21, 25, Op::Kind::Get, "k", "", "ab"),
+  };
+  MT_ASSERT(kvraft::check_linearizable_kv(good));
+  // concurrent write overlap: reads may see either order, consistently
+  std::vector<HistOp> good2{
+      op(0, 10, Op::Kind::Put, "k", "a", ""),
+      op(0, 10, Op::Kind::Put, "k", "b", ""),
+      op(20, 30, Op::Kind::Get, "k", "", "b"),
+      op(40, 50, Op::Kind::Get, "k", "", "b"),
+  };
+  MT_ASSERT(kvraft::check_linearizable_kv(good2));
+  // stale read: a completed put must be visible to a later get
+  std::vector<HistOp> stale{
+      op(0, 10, Op::Kind::Put, "k", "a", ""),
+      op(20, 30, Op::Kind::Get, "k", "", ""),
+  };
+  MT_ASSERT(!kvraft::check_linearizable_kv(stale));
+  // flip-flop reads with no interleaving write: not linearizable
+  std::vector<HistOp> flip{
+      op(0, 10, Op::Kind::Put, "k", "a", ""),
+      op(0, 10, Op::Kind::Put, "k", "b", ""),
+      op(20, 30, Op::Kind::Get, "k", "", "a"),
+      op(40, 50, Op::Kind::Get, "k", "", "b"),
+  };
+  MT_ASSERT(!kvraft::check_linearizable_kv(flip));
+  // duplicate append visible: not linearizable
+  std::vector<HistOp> dup{
+      op(0, 10, Op::Kind::Append, "k", "x", ""),
+      op(20, 30, Op::Kind::Get, "k", "", "xx"),
+  };
+  MT_ASSERT(!kvraft::check_linearizable_kv(dup));
+  // per-key decomposition: independent keys don't constrain each other
+  std::vector<HistOp> multi{
+      op(0, 10, Op::Kind::Put, "a", "1", ""),
+      op(0, 10, Op::Kind::Put, "b", "2", ""),
+      op(20, 30, Op::Kind::Get, "a", "", "1"),
+      op(20, 30, Op::Kind::Get, "b", "", "2"),
+  };
+  MT_ASSERT(kvraft::check_linearizable_kv(multi));
+}
+
+// a client doing random get/put/append on a small key set, recording the
+// history with virtual invoke/return times
+simcore::Task<void> lin_client(Sim* sim, KvTester::Clerk ck, int cli,
+                               std::shared_ptr<bool> done,
+                               std::shared_ptr<std::vector<kvraft::HistOp>> hist) {
+  uint64_t j = 0;
+  while (!*done) {
+    kvraft::HistOp h;
+    h.key = std::to_string((int)(sim->rand_u64() % 3));
+    double r = sim->rand_f64();
+    h.invoke = sim->now();
+    if (r < 0.5) {
+      h.kind = Op::Kind::Get;
+      h.output = co_await ck.get(h.key);
+    } else {
+      char buf[64];
+      std::snprintf(buf, sizeof buf, "v%d.%llu ", cli, (unsigned long long)j++);
+      h.input = buf;
+      if (r < 0.75) {
+        h.kind = Op::Kind::Put;
+        co_await ck.put(h.key, h.input);
+      } else {
+        h.kind = Op::Kind::Append;
+        co_await ck.append(h.key, h.input);
+      }
+    }
+    h.ret = sim->now();
+    hist->push_back(std::move(h));
+    co_await sim->sleep(sim->rand_range(5, 50) * MSEC);
+  }
+}
+
+// generic_test_linearizability (tests.rs:389/527): concurrent clients under
+// partitions + crashes (+ snapshots for 3B); full-history linearizability
+// check instead of client-side value prediction
+simcore::Task<void> lin_main(Sim* sim, int nclients, bool unreliable,
+                             std::optional<size_t> maxraftstate) {
+  constexpr int NSERVERS = 5;
+  KvTester t(sim, NSERVERS, unreliable, maxraftstate);
+  co_await sim->spawn(t.init());
+  auto hist = std::make_shared<std::vector<kvraft::HistOp>>();
+
+  for (int iter = 0; iter < 2; iter++) {
+    auto done = std::make_shared<bool>(false);
+    std::vector<simcore::TaskRef<void>> cas;
+    for (int cli = 0; cli < nclients; cli++)
+      cas.push_back(sim->spawn(
+          lin_client(sim, t.make_client(t.all()), cli, done, hist)));
+
+    co_await sim->sleep(1 * SEC);
+    auto parter = sim->spawn(repartitioner(sim, &t, done));
+    co_await sim->sleep(4 * SEC);
+    *done = true;
+    co_await parter;
+    t.connect_all();
+    co_await sim->sleep(KV_ELECTION_TIMEOUT);
+
+    // crash-restart the whole cluster mid-history
+    for (int i = 0; i < NSERVERS; i++) t.shutdown_server(i);
+    co_await sim->sleep(KV_ELECTION_TIMEOUT);
+    for (int i = 0; i < NSERVERS; i++) co_await sim->spawn(t.start_server(i));
+    t.connect_all();
+
+    for (auto& c : cas) co_await c;  // all ops complete: no open invocations
+  }
+  MT_ASSERT(kvraft::check_linearizable_kv(*hist));
+  std::printf("  linearizability: %zu ops OK\n", hist->size());
+  t.end();
+}
+
+}  // namespace
+
+MT_TEST(kv_linearize_checker_unit) { linearize_checker_unit(seed); }
+MT_TEST(kv_linearizability_3a) {
+  Sim sim(seed);
+  MT_ASSERT(sim.run(lin_main(&sim, 7, true, {})));
+}
+MT_TEST(kv_linearizability_3b) {
+  Sim sim(seed);
+  MT_ASSERT(sim.run(lin_main(&sim, 7, true, 1000)));
 }

@@ -15,6 +15,19 @@ from madraft_tpu.tpusim.config import SimConfig
 from madraft_tpu.tpusim.state import ClusterState, init_cluster
 from madraft_tpu.tpusim.step import step_cluster
 from madraft_tpu.tpusim.engine import FuzzReport, fuzz, make_fuzz_fn
+from madraft_tpu.tpusim.kv import (
+    VIOLATION_EXACTLY_ONCE,
+    VIOLATION_KV_DIVERGE,
+    KvConfig,
+    KvFuzzReport,
+    KvState,
+    init_kv_cluster,
+    kv_fuzz,
+    kv_replay_cluster,
+    kv_report,
+    kv_step,
+    make_kv_fuzz_fn,
+)
 
 __all__ = [
     "SimConfig",
@@ -24,4 +37,15 @@ __all__ = [
     "FuzzReport",
     "fuzz",
     "make_fuzz_fn",
+    "KvConfig",
+    "KvFuzzReport",
+    "KvState",
+    "init_kv_cluster",
+    "kv_fuzz",
+    "kv_replay_cluster",
+    "kv_report",
+    "kv_step",
+    "make_kv_fuzz_fn",
+    "VIOLATION_EXACTLY_ONCE",
+    "VIOLATION_KV_DIVERGE",
 ]

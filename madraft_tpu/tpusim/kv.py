@@ -1,0 +1,355 @@
+"""Batched KV-service fuzzing on top of the Raft tick (Lab 3 on TPU).
+
+This is the on-device analogue of the reference's kvraft layer and its test
+oracles (SURVEY.md §4.2, /root/reference/src/kvraft/):
+
+- Clerks are tensors: per cluster, ``n_clients`` clerks each hold one
+  outstanding (client, seq, key) op at a time and bump seq only after the op
+  committed — the ClerkCore contract (client.rs:32-63). A clerk whose op is
+  not yet committed re-submits with some probability each tick, possibly to a
+  *different* leader: that is exactly the duplicate-entry hazard the server's
+  dup table must absorb (server.rs:68-70's "dedup retries").
+- Each node runs an apply machine: an apply cursor chasing its commit index,
+  a per-client dup table (last applied seq), and per-key rolling hashes of
+  the applied append stream. Restart wipes the apply machine; it rebuilds by
+  replaying the recovered log — the reference's restore-then-replay path.
+- Oracles run as on-device reductions every tick:
+    * exactly-once/order (VIOLATION_EXACTLY_ONCE): at apply, a client's seqs
+      must arrive gap-free, and the number of applied ops must equal the
+      highest applied seq (each op applied exactly once, in order) — the
+      batched form of check_clnt_appends (tests.rs:21-43) and of the rsm
+      seq-gap abort.
+    * state-machine agreement (VIOLATION_KV_DIVERGE): two alive nodes whose
+      apply cursors are equal must hold identical per-key hashes and counts
+      (they applied the same committed prefix). This is the linearizability
+      core the reference leaves commented out (tests.rs:386-390): commits are
+      totally ordered by the log, so agreement on every applied prefix +
+      exactly-once application is what a per-key history checker would
+      verify.
+- Deliberate bug modes validate the oracles: ``bug_skip_dedup`` applies
+  duplicates (exactly-once must fire); ``bug_apply_uncommitted`` applies up
+  to log_len instead of commit (agreement must fire).
+
+The command stream reuses the raft log's i32 value channel: a KV op is packed
+as (client, seq, key) — unique per op, never zero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from madraft_tpu.tpusim.config import LEADER, SimConfig
+from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
+from madraft_tpu.tpusim.step import step_cluster
+
+# Additional violation bits (extending config.VIOLATION_*).
+VIOLATION_EXACTLY_ONCE = 8   # duplicate or out-of-order apply of a client op
+VIOLATION_KV_DIVERGE = 16    # equal apply cursors, different KV state
+
+_SEQ_LIM = 1 << 15  # packing limit: seq fits 15 bits
+
+# PRNG site ids, disjoint from step.py's 0..7.
+_S_CLERK_START, _S_CLERK_TARGET, _S_CLERK_RETRY, _S_CLERK_KEY = 8, 9, 10, 11
+
+
+@dataclasses.dataclass(frozen=True)
+class KvConfig:
+    """Static knobs of the KV fuzzing layer."""
+
+    n_clients: int = 4
+    n_keys: int = 4
+    p_op: float = 0.3           # idle clerk starts a fresh op
+    p_retry: float = 0.5        # pending clerk re-submits this tick
+    apply_max: int = 4          # apply-machine entries per node per tick
+    # Oracle-validation bug modes (None/False = correct service).
+    bug_skip_dedup: bool = False        # apply duplicates blindly
+    bug_apply_uncommitted: bool = False  # apply past the commit index
+
+    def replace(self, **kw) -> "KvConfig":
+        return dataclasses.replace(self, **kw)
+
+
+class KvState(NamedTuple):
+    """Raft cluster state + the KV service layer (vmap adds the cluster axis)."""
+
+    raft: ClusterState
+    # --- clerks [NC] ---
+    clerk_seq: jax.Array     # i32 last started seq (0 = none yet)
+    clerk_out: jax.Array     # bool: op clerk_seq is still uncommitted
+    clerk_key: jax.Array     # i32 key of the outstanding op
+    clerk_acked: jax.Array   # i32 highest committed (acked) seq
+    # --- per-node apply machines (volatile: wiped by crash, rebuilt by replay)
+    applied: jax.Array       # i32 [N] apply cursor (entries applied)
+    last_seq: jax.Array      # i32 [N, NC] dup table: last applied seq
+    apply_count: jax.Array   # i32 [N, NC] ops applied (must equal last_seq)
+    key_hash: jax.Array      # i32 [N, NK] rolling hash of applied appends
+    key_count: jax.Array     # i32 [N, NK] applied appends per key
+
+
+def _pack(cfg: KvConfig, client, seq, key):
+    return ((client * _SEQ_LIM + seq) * cfg.n_keys + key) + 1
+
+
+def _unpack(cfg: KvConfig, val):
+    v = val - 1
+    key = v % cfg.n_keys
+    cs = v // cfg.n_keys
+    return cs // _SEQ_LIM, cs % _SEQ_LIM, key  # client, seq, key
+
+
+def init_kv_cluster(cfg: SimConfig, kcfg: KvConfig, key: jax.Array) -> KvState:
+    n, nc, nk = cfg.n_nodes, kcfg.n_clients, kcfg.n_keys
+    return KvState(
+        raft=init_cluster(cfg, key),
+        clerk_seq=jnp.zeros((nc,), I32),
+        clerk_out=jnp.zeros((nc,), jnp.bool_),
+        clerk_key=jnp.zeros((nc,), I32),
+        clerk_acked=jnp.zeros((nc,), I32),
+        applied=jnp.zeros((n,), I32),
+        last_seq=jnp.zeros((n, nc), I32),
+        apply_count=jnp.zeros((n, nc), I32),
+        key_hash=jnp.zeros((n, nk), I32),
+        key_count=jnp.zeros((n, nk), I32),
+    )
+
+
+def kv_step(
+    cfg: SimConfig, kcfg: KvConfig, ks: KvState, cluster_key: jax.Array
+) -> KvState:
+    """One lockstep tick: raft tick, then apply machines, oracles, clerks."""
+    assert cfg.p_client_cmd == 0.0, "KV layer owns command injection"
+    n, cap, nc = cfg.n_nodes, cfg.log_cap, kcfg.n_clients
+    me = jnp.arange(n, dtype=I32)
+
+    pre_alive = ks.raft.alive
+    s = step_cluster(cfg, ks.raft, cluster_key)
+    t = s.tick
+    key = jax.random.fold_in(cluster_key, t)
+
+    # Crash/restart wipes the volatile apply machine; replay rebuilds it
+    # (restore() + apply-channel replay, raft.rs:194-211).
+    fresh = ~pre_alive & s.alive | ~s.alive
+    applied = jnp.where(fresh, 0, ks.applied)
+    last_seq = jnp.where(fresh[:, None], 0, ks.last_seq)
+    apply_count = jnp.where(fresh[:, None], 0, ks.apply_count)
+    key_hash = jnp.where(fresh[:, None], 0, ks.key_hash)
+    key_count = jnp.where(fresh[:, None], 0, ks.key_count)
+
+    # ---------------------------------------------------------- apply machines
+    viol = jnp.asarray(0, I32)
+    limit = s.log_len if kcfg.bug_apply_uncommitted else s.commit
+    for _ in range(kcfg.apply_max):
+        can = s.alive & (applied < limit)
+        pos = jnp.clip(applied, 0, cap - 1)
+        val = s.log_val[me, pos]
+        client, seq, k = _unpack(kcfg, val)
+        client = jnp.clip(client, 0, nc - 1)
+        prev = last_seq[me, client]
+        dup = seq <= prev
+        # order oracle: a first-time seq must be exactly prev+1 (the clerk
+        # starts s+1 only after s committed, so committed order is gap-free)
+        viol |= jnp.where(jnp.any(can & ~dup & (seq > prev + 1)),
+                          VIOLATION_EXACTLY_ONCE, 0)
+        do = can if kcfg.bug_skip_dedup else (can & ~dup)
+        key_hash = key_hash.at[me, k].set(
+            jnp.where(do, key_hash[me, k] * 1000003 + val, key_hash[me, k])
+        )
+        key_count = key_count.at[me, k].set(
+            jnp.where(do, key_count[me, k] + 1, key_count[me, k])
+        )
+        apply_count = apply_count.at[me, client].set(
+            jnp.where(do, apply_count[me, client] + 1, apply_count[me, client])
+        )
+        last_seq = last_seq.at[me, client].set(
+            jnp.where(can, jnp.maximum(prev, seq), prev)
+        )
+        applied = jnp.where(can, applied + 1, applied)
+
+    # exactly-once: ops applied per client == highest seq applied
+    viol |= jnp.where(jnp.any(s.alive[:, None] & (apply_count != last_seq)),
+                      VIOLATION_EXACTLY_ONCE, 0)
+
+    # state-machine agreement: equal cursors => identical applied state
+    same_cursor = (
+        (applied[:, None] == applied[None, :])
+        & (applied[:, None] > 0)
+        & s.alive[:, None] & s.alive[None, :]
+    )
+    hash_eq = jnp.all(
+        (key_hash[:, None, :] == key_hash[None, :, :])
+        & (key_count[:, None, :] == key_count[None, :, :]),
+        axis=2,
+    )
+    viol |= jnp.where(jnp.any(same_cursor & ~hash_eq), VIOLATION_KV_DIVERGE, 0)
+
+    violations = s.violations | viol
+    first_violation_tick = jnp.where(
+        (s.first_violation_tick < 0) & (viol != 0), t, s.first_violation_tick
+    )
+
+    # ------------------------------------------------------------------ clerks
+    # ack: an outstanding op is acked once it appears in the committed shadow
+    # log (ground truth of commits — the clerk's Ok reply).
+    want = _pack(kcfg, jnp.arange(nc, dtype=I32), ks.clerk_seq, ks.clerk_key)
+    in_shadow = jnp.any(
+        (s.shadow_val[None, :] == want[:, None])
+        & (jnp.arange(cap)[None, :] < s.shadow_len),
+        axis=1,
+    )
+    newly_acked = ks.clerk_out & in_shadow
+    clerk_acked = jnp.where(newly_acked, ks.clerk_seq, ks.clerk_acked)
+    clerk_out = ks.clerk_out & ~newly_acked
+
+    # start fresh ops / retry pending ones
+    kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 4)
+    start = (
+        ~clerk_out
+        & jax.random.bernoulli(kk[0], kcfg.p_op, (nc,))
+        & (ks.clerk_seq < _SEQ_LIM - 1)
+    )
+    clerk_seq = jnp.where(start, ks.clerk_seq + 1, ks.clerk_seq)
+    clerk_key = jnp.where(
+        start,
+        jax.random.randint(kk[1], (nc,), 0, kcfg.n_keys, dtype=I32),
+        ks.clerk_key,
+    )
+    clerk_out = clerk_out | start
+    retry = clerk_out & (
+        start | jax.random.bernoulli(kk[2], kcfg.p_retry, (nc,))
+    )
+    target = jax.random.randint(kk[3], (nc,), 0, n, dtype=I32)
+
+    # submit: append at the targeted node iff it believes it is the leader
+    # (RaftHandle::start, raft.rs:131; a stale leader accepts and the entry
+    # is later overwritten — the rejoin_2b scenario).
+    log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
+    for c in range(nc):
+        tgt = target[c]
+        ok = (
+            retry[c]
+            & s.alive[tgt]
+            & (s.role[tgt] == LEADER)
+            & (log_len[tgt] < cap)
+        )
+        slot = jnp.clip(log_len[tgt], 0, cap - 1)
+        v = _pack(kcfg, jnp.asarray(c, I32), clerk_seq[c], clerk_key[c])
+        log_term = log_term.at[tgt, slot].set(
+            jnp.where(ok, s.term[tgt], log_term[tgt, slot])
+        )
+        log_val = log_val.at[tgt, slot].set(jnp.where(ok, v, log_val[tgt, slot]))
+        log_len = log_len.at[tgt].set(jnp.where(ok, log_len[tgt] + 1, log_len[tgt]))
+
+    raft = s._replace(
+        log_term=log_term,
+        log_val=log_val,
+        log_len=log_len,
+        violations=violations,
+        first_violation_tick=first_violation_tick,
+    )
+    return KvState(
+        raft=raft,
+        clerk_seq=clerk_seq,
+        clerk_out=clerk_out,
+        clerk_key=clerk_key,
+        clerk_acked=clerk_acked,
+        applied=applied,
+        last_seq=last_seq,
+        apply_count=apply_count,
+        key_hash=key_hash,
+        key_count=key_count,
+    )
+
+
+# ------------------------------------------------------------------- drivers
+class KvFuzzReport(NamedTuple):
+    violations: np.ndarray            # i32 bitmask per cluster
+    first_violation_tick: np.ndarray  # -1 = none
+    acked_ops: np.ndarray             # committed client ops per cluster
+    committed: np.ndarray             # committed log entries per cluster
+    msg_count: np.ndarray
+
+    @property
+    def n_violating(self) -> int:
+        return int((self.violations != 0).sum())
+
+    def violating_clusters(self) -> np.ndarray:
+        return np.nonzero(self.violations != 0)[0]
+
+
+def make_kv_fuzz_fn(
+    cfg: SimConfig,
+    kcfg: KvConfig,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+):
+    """Build a jitted fn(seed) -> final batched KvState (see engine.make_fuzz_fn)."""
+    constraint = None
+    if mesh is not None:
+        constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
+
+    def run(seed) -> KvState:
+        base = jax.random.PRNGKey(seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(n_clusters)
+        )
+        states = jax.vmap(functools.partial(init_kv_cluster, cfg, kcfg))(keys)
+        if constraint is not None:
+            states = jax.lax.with_sharding_constraint(
+                states, jax.tree.map(lambda _: constraint, states)
+            )
+            keys = jax.lax.with_sharding_constraint(keys, constraint)
+
+        def body(carry, _):
+            nxt = jax.vmap(functools.partial(kv_step, cfg, kcfg))(carry, keys)
+            return nxt, None
+
+        final, _ = jax.lax.scan(body, states, None, length=n_ticks)
+        return final
+
+    return jax.jit(run)
+
+
+def kv_report(final: KvState) -> KvFuzzReport:
+    return KvFuzzReport(
+        violations=np.asarray(final.raft.violations),
+        first_violation_tick=np.asarray(final.raft.first_violation_tick),
+        acked_ops=np.asarray(final.clerk_acked.sum(axis=-1)),
+        committed=np.asarray(final.raft.shadow_len),
+        msg_count=np.asarray(final.raft.msg_count),
+    )
+
+
+def kv_fuzz(
+    cfg: SimConfig,
+    kcfg: KvConfig,
+    seed: int,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+) -> KvFuzzReport:
+    """Fuzz the KV service over n_clusters independent simulated clusters."""
+    fn = make_kv_fuzz_fn(cfg, kcfg, n_clusters, n_ticks, mesh=mesh)
+    final = jax.block_until_ready(fn(jnp.asarray(seed, jnp.uint32)))
+    return kv_report(final)
+
+
+def kv_replay_cluster(
+    cfg: SimConfig, kcfg: KvConfig, seed: int, cluster_id: int, n_ticks: int
+) -> KvState:
+    """Re-run one cluster for inspection (the (seed, cluster_id) replay contract)."""
+    ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
+    state = init_kv_cluster(cfg, kcfg, ckey)
+
+    def body(carry, _):
+        return kv_step(cfg, kcfg, carry, ckey), None
+
+    final, _ = jax.lax.scan(body, state, None, length=n_ticks)
+    return jax.block_until_ready(final)

@@ -7,7 +7,18 @@ pytest and see the real TPU.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard assignment, not setdefault: the driver environment presets
+# JAX_PLATFORMS (e.g. the TPU tunnel), and tests must still run on the
+# virtual CPU mesh — single-core TPU can't exercise the 8-way sharding path.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's interpreter-startup hook (sitecustomize) registers the
+# TPU-tunnel plugin and force-updates jax's platform config to "axon,cpu",
+# defeating the env var above. Re-assert CPU after import — backends are not
+# initialized yet at conftest time, so this sticks.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
