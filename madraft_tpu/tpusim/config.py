@@ -20,8 +20,19 @@ class SimConfig:
     """Static parameters of one batched simulation. All times are in ticks."""
 
     n_nodes: int = 5
-    log_cap: int = 64        # fixed log capacity (circular compaction in snapshot mode)
+    log_cap: int = 64        # window capacity: entries retained past the snapshot
     ae_max: int = 4          # max entries carried per AppendEntries message
+
+    # Log compaction (the Lab 2D snapshot path, raft.rs:149-168): a node
+    # discards its window prefix up to the compaction boundary every
+    # `compact_every` committed-and-covered entries; a leader whose peer lags
+    # behind its snapshot sends an install-snapshot instead of entries.
+    # With compact_at_commit=True the boundary is the commit index (pure-raft
+    # fuzzing); service layers (kv.py) set False and drive the boundary via
+    # the per-node compact_floor state (their apply cursor), so a snapshot
+    # never outruns the state machine.
+    compact_every: int = 16
+    compact_at_commit: bool = True
 
     # Virtual-time quantization: 1 tick ~ 10 simulated ms.
     ms_per_tick: int = 10

@@ -31,6 +31,7 @@ class FuzzReport(NamedTuple):
     first_leader_tick: np.ndarray     # -1 = never elected (liveness signal)
     committed: np.ndarray             # entries ever committed (shadow length)
     msg_count: np.ndarray             # delivered messages
+    snap_installs: np.ndarray         # install-snapshot deliveries (2D metric)
 
     @property
     def n_violating(self) -> int:
@@ -89,6 +90,7 @@ def report(final: ClusterState) -> FuzzReport:
         first_leader_tick=np.asarray(final.first_leader_tick),
         committed=np.asarray(final.shadow_len),
         msg_count=np.asarray(final.msg_count),
+        snap_installs=np.asarray(final.snap_install_count),
     )
 
 

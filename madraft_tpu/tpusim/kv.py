@@ -85,12 +85,20 @@ class KvState(NamedTuple):
     clerk_out: jax.Array     # bool: op clerk_seq is still uncommitted
     clerk_key: jax.Array     # i32 key of the outstanding op
     clerk_acked: jax.Array   # i32 highest committed (acked) seq
-    # --- per-node apply machines (volatile: wiped by crash, rebuilt by replay)
-    applied: jax.Array       # i32 [N] apply cursor (entries applied)
+    # --- per-node apply machines. The live set is volatile (crash resets to
+    # the snapshot); the snap_* set is the persisted service snapshot at the
+    # node's log base (the reference's "snapshot" file: dup table + state,
+    # rsm.h save_snapshot), captured at compaction and shipped by
+    # install-snapshot.
+    applied: jax.Array       # i32 [N] apply cursor, absolute (>= base)
     last_seq: jax.Array      # i32 [N, NC] dup table: last applied seq
     apply_count: jax.Array   # i32 [N, NC] ops applied (must equal last_seq)
     key_hash: jax.Array      # i32 [N, NK] rolling hash of applied appends
     key_count: jax.Array     # i32 [N, NK] applied appends per key
+    snap_last_seq: jax.Array     # i32 [N, NC] (persistent)
+    snap_apply_count: jax.Array  # i32 [N, NC] (persistent)
+    snap_key_hash: jax.Array     # i32 [N, NK] (persistent)
+    snap_key_count: jax.Array    # i32 [N, NK] (persistent)
 
 
 def _pack(cfg: KvConfig, client, seq, key):
@@ -117,6 +125,10 @@ def init_kv_cluster(cfg: SimConfig, kcfg: KvConfig, key: jax.Array) -> KvState:
         apply_count=jnp.zeros((n, nc), I32),
         key_hash=jnp.zeros((n, nk), I32),
         key_count=jnp.zeros((n, nk), I32),
+        snap_last_seq=jnp.zeros((n, nc), I32),
+        snap_apply_count=jnp.zeros((n, nc), I32),
+        snap_key_hash=jnp.zeros((n, nk), I32),
+        snap_key_count=jnp.zeros((n, nk), I32),
     )
 
 
@@ -125,29 +137,65 @@ def kv_step(
 ) -> KvState:
     """One lockstep tick: raft tick, then apply machines, oracles, clerks."""
     assert cfg.p_client_cmd == 0.0, "KV layer owns command injection"
+    assert not cfg.compact_at_commit, (
+        "KV fuzzing needs cfg.compact_at_commit=False: the compaction "
+        "boundary must follow the apply cursor, not the commit index"
+    )
     n, cap, nc = cfg.n_nodes, cfg.log_cap, kcfg.n_clients
     me = jnp.arange(n, dtype=I32)
 
-    pre_alive = ks.raft.alive
-    s = step_cluster(cfg, ks.raft, cluster_key)
+    pre = ks.raft
+    s = step_cluster(cfg, pre, cluster_key)
     t = s.tick
     key = jax.random.fold_in(cluster_key, t)
 
-    # Crash/restart wipes the volatile apply machine; replay rebuilds it
-    # (restore() + apply-channel replay, raft.rs:194-211).
-    fresh = ~pre_alive & s.alive | ~s.alive
-    applied = jnp.where(fresh, 0, ks.applied)
-    last_seq = jnp.where(fresh[:, None], 0, ks.last_seq)
-    apply_count = jnp.where(fresh[:, None], 0, ks.apply_count)
-    key_hash = jnp.where(fresh[:, None], 0, ks.key_hash)
-    key_count = jnp.where(fresh[:, None], 0, ks.key_count)
+    applied = ks.applied
+    last_seq, apply_count = ks.last_seq, ks.apply_count
+    key_hash, key_count = ks.key_hash, ks.key_count
+    snap_last_seq, snap_apply_count = ks.snap_last_seq, ks.snap_apply_count
+    snap_key_hash, snap_key_count = ks.snap_key_hash, ks.snap_key_count
+
+    # 1. Crash/restart: the live apply machine resets to the node's own
+    #    persisted snapshot; log replay from base rebuilds the rest
+    #    (restore() + apply-channel replay, raft.rs:194-211).
+    fresh = (~pre.alive & s.alive) | ~s.alive
+    applied = jnp.where(fresh, s.base, applied)
+    last_seq = jnp.where(fresh[:, None], snap_last_seq, last_seq)
+    apply_count = jnp.where(fresh[:, None], snap_apply_count, apply_count)
+    key_hash = jnp.where(fresh[:, None], snap_key_hash, key_hash)
+    key_count = jnp.where(fresh[:, None], snap_key_count, key_count)
+
+    # 2. Compaction this tick (base advanced, no install): the boundary is the
+    #    pre-tick apply cursor (compact_floor), so the live tables BEFORE this
+    #    tick's apply loop are exactly the state at the new base — capture
+    #    them as the persisted snapshot (rsm.h maybe_snapshot).
+    inst = s.snap_installed_src >= 0
+    comp = (s.base != pre.base) & ~inst & s.alive
+    snap_last_seq = jnp.where(comp[:, None], last_seq, snap_last_seq)
+    snap_apply_count = jnp.where(comp[:, None], apply_count, snap_apply_count)
+    snap_key_hash = jnp.where(comp[:, None], key_hash, snap_key_hash)
+    snap_key_count = jnp.where(comp[:, None], key_count, snap_key_count)
+
+    # 3. Install-snapshot this tick: adopt the sender's persisted snapshot
+    #    (its pre-tick snap tables match the pre-tick base the trigger
+    #    carried) as both live and persisted state; jump the cursor.
+    src = jnp.clip(s.snap_installed_src, 0, n - 1)
+    applied = jnp.where(inst, s.base, applied)
+    last_seq = jnp.where(inst[:, None], ks.snap_last_seq[src], last_seq)
+    apply_count = jnp.where(inst[:, None], ks.snap_apply_count[src], apply_count)
+    key_hash = jnp.where(inst[:, None], ks.snap_key_hash[src], key_hash)
+    key_count = jnp.where(inst[:, None], ks.snap_key_count[src], key_count)
+    snap_last_seq = jnp.where(inst[:, None], ks.snap_last_seq[src], snap_last_seq)
+    snap_apply_count = jnp.where(inst[:, None], ks.snap_apply_count[src], snap_apply_count)
+    snap_key_hash = jnp.where(inst[:, None], ks.snap_key_hash[src], snap_key_hash)
+    snap_key_count = jnp.where(inst[:, None], ks.snap_key_count[src], snap_key_count)
 
     # ---------------------------------------------------------- apply machines
     viol = jnp.asarray(0, I32)
     limit = s.log_len if kcfg.bug_apply_uncommitted else s.commit
     for _ in range(kcfg.apply_max):
         can = s.alive & (applied < limit)
-        pos = jnp.clip(applied, 0, cap - 1)
+        pos = jnp.clip(applied - s.base, 0, cap - 1)  # window slot of applied+1
         val = s.log_val[me, pos]
         client, seq, k = _unpack(kcfg, val)
         client = jnp.clip(client, 0, nc - 1)
@@ -196,11 +244,12 @@ def kv_step(
 
     # ------------------------------------------------------------------ clerks
     # ack: an outstanding op is acked once it appears in the committed shadow
-    # log (ground truth of commits — the clerk's Ok reply).
+    # log (ground truth of commits — the clerk's Ok reply). The shadow is a
+    # window; a clerk polls every tick, far faster than the window slides.
     want = _pack(kcfg, jnp.arange(nc, dtype=I32), ks.clerk_seq, ks.clerk_key)
     in_shadow = jnp.any(
         (s.shadow_val[None, :] == want[:, None])
-        & (jnp.arange(cap)[None, :] < s.shadow_len),
+        & (jnp.arange(cap)[None, :] < s.shadow_len - s.shadow_base),
         axis=1,
     )
     newly_acked = ks.clerk_out & in_shadow
@@ -236,9 +285,9 @@ def kv_step(
             retry[c]
             & s.alive[tgt]
             & (s.role[tgt] == LEADER)
-            & (log_len[tgt] < cap)
+            & (log_len[tgt] - s.base[tgt] < cap)  # window has room
         )
-        slot = jnp.clip(log_len[tgt], 0, cap - 1)
+        slot = jnp.clip(log_len[tgt] - s.base[tgt], 0, cap - 1)
         v = _pack(kcfg, jnp.asarray(c, I32), clerk_seq[c], clerk_key[c])
         log_term = log_term.at[tgt, slot].set(
             jnp.where(ok, s.term[tgt], log_term[tgt, slot])
@@ -252,6 +301,8 @@ def kv_step(
         log_len=log_len,
         violations=violations,
         first_violation_tick=first_violation_tick,
+        # next tick's compaction boundary: never past what we've applied
+        compact_floor=applied,
     )
     return KvState(
         raft=raft,
@@ -264,6 +315,10 @@ def kv_step(
         apply_count=apply_count,
         key_hash=key_hash,
         key_count=key_count,
+        snap_last_seq=snap_last_seq,
+        snap_apply_count=snap_apply_count,
+        snap_key_hash=snap_key_hash,
+        snap_key_count=snap_key_count,
     )
 
 
@@ -274,6 +329,7 @@ class KvFuzzReport(NamedTuple):
     acked_ops: np.ndarray             # committed client ops per cluster
     committed: np.ndarray             # committed log entries per cluster
     msg_count: np.ndarray
+    snap_installs: np.ndarray         # install-snapshot deliveries
 
     @property
     def n_violating(self) -> int:
@@ -324,6 +380,7 @@ def kv_report(final: KvState) -> KvFuzzReport:
         acked_ops=np.asarray(final.clerk_acked.sum(axis=-1)),
         committed=np.asarray(final.raft.shadow_len),
         msg_count=np.asarray(final.raft.msg_count),
+        snap_installs=np.asarray(final.raft.snap_install_count),
     )
 
 

@@ -14,8 +14,13 @@ Design notes (vs the reference, SURVEY.md §2.6/§7):
   (/root/reference/src/raft/tester.rs:127-137): each directed (dst, src) pair has one
   slot per message type with a delivery tick; overwriting an undelivered slot models
   packet loss (counted faithfully as Raft must tolerate it).
-- Log indices are 1-based as in Raft; array slot k holds index k+1. ``log_len`` and
-  ``commit`` are counts (== highest index present / committed).
+- Log indices are 1-based as in Raft. The log array is a WINDOW: ``base`` is the
+  snapshot boundary (indices 1..base are compacted away), slot k holds absolute
+  index ``base + k + 1``, and ``log_len`` / ``commit`` stay ABSOLUTE (highest
+  index present / committed). ``snap_term`` is the term at index ``base``.
+  Compaction shifts the window left; an install-snapshot adopts a peer's
+  boundary. This is what lets fuzz histories run far past ``log_cap``
+  (SURVEY.md §5: "long histories → fixed-size buffers + on-device compaction").
 """
 
 from __future__ import annotations
@@ -42,11 +47,16 @@ class ClusterState(NamedTuple):
     timer: jax.Array           # i32 ticks until election timeout
     hb: jax.Array              # i32 ticks until next leader heartbeat
     alive: jax.Array           # bool
-    # --- log [N, CAP] (persistent) ---
+    # --- log window [N, CAP] (persistent; slot k = absolute index base+k+1) ---
     log_term: jax.Array        # i32
     log_val: jax.Array         # i32 (commands are unique ints)
-    log_len: jax.Array         # i32 [N] entry count
-    commit: jax.Array          # i32 [N] committed count (volatile)
+    log_len: jax.Array         # i32 [N] absolute length (highest index present)
+    base: jax.Array            # i32 [N] snapshot boundary (persistent)
+    snap_term: jax.Array       # i32 [N] term at index `base` (persistent)
+    commit: jax.Array          # i32 [N] committed count, absolute (volatile)
+    compact_floor: jax.Array   # i32 [N] service-layer cap on the compaction
+    #                            boundary (= its apply cursor); unused when
+    #                            cfg.compact_at_commit
     # --- candidate / leader bookkeeping ---
     votes: jax.Array           # bool [N, N]: votes[i, j] = candidate i holds j's grant
     next_idx: jax.Array        # i32 [N, N]: leader i's next index for peer j (1-based)
@@ -76,15 +86,32 @@ class ClusterState(NamedTuple):
     ae_rsp_term: jax.Array
     ae_rsp_success: jax.Array  # bool
     ae_rsp_match: jax.Array    # success: new match count; failure: next-index hint - 1
+    # InstallSnapshot trigger mailbox [dst, src] (raft.rs:149-168). The payload
+    # (boundary, snapshot term, service state) is read from the SENDER's live
+    # snapshot at delivery — semantically the snapshot "sent at delivery
+    # instant"; a dead sender at delivery = a lost message. The LEADER term
+    # rides in the message (sn_req_term): like every RPC it deposes stale
+    # leaders, and an install is only accepted from the current term's leader
+    # — otherwise a deposed leader could truncate its fork and re-mint old
+    # indices in its stale term, breaking log matching. Install outcome is
+    # surfaced to service layers via snap_installed_src/len below.
+    sn_req_t: jax.Array
+    sn_req_term: jax.Array
+    snap_installed_src: jax.Array  # i32 [N]: src installed from this tick (-1)
+    snap_installed_len: jax.Array  # i32 [N]: boundary adopted this tick
     # --- workload / oracle ---
     next_cmd: jax.Array        # i32 scalar: per-cluster unique command counter
-    shadow_term: jax.Array     # i32 [CAP] committed-entry shadow (durability oracle)
+    # Committed-entry shadow (durability oracle) — windowed like the logs:
+    # slot k = absolute index shadow_base+k+1; shadow_len is absolute.
+    shadow_term: jax.Array     # i32 [CAP]
     shadow_val: jax.Array      # i32 [CAP]
+    shadow_base: jax.Array     # i32 scalar
     shadow_len: jax.Array      # i32 scalar
     violations: jax.Array      # i32 scalar sticky bitmask
     first_violation_tick: jax.Array  # i32 scalar, -1 = none
     first_leader_tick: jax.Array     # i32 scalar, -1 = none (liveness metric)
     msg_count: jax.Array       # i32 scalar: delivered messages (tester.rs:147-149)
+    snap_install_count: jax.Array  # i32 scalar: snapshot installs (2D metric)
 
 
 def init_cluster(cfg: SimConfig, key: jax.Array) -> ClusterState:
@@ -106,7 +133,10 @@ def init_cluster(cfg: SimConfig, key: jax.Array) -> ClusterState:
         log_term=jnp.zeros((n, cap), I32),
         log_val=jnp.zeros((n, cap), I32),
         log_len=zn,
+        base=zn,
+        snap_term=zn,
         commit=zn,
+        compact_floor=zn,
         votes=jnp.zeros((n, n), BOOL),
         next_idx=jnp.ones((n, n), I32),
         match_idx=znn,
@@ -119,12 +149,18 @@ def init_cluster(cfg: SimConfig, key: jax.Array) -> ClusterState:
         ae_req_ent_val=jnp.zeros((n, n, ae), I32),
         ae_rsp_t=znn, ae_rsp_term=znn,
         ae_rsp_success=jnp.zeros((n, n), BOOL), ae_rsp_match=znn,
+        sn_req_t=znn,
+        sn_req_term=znn,
+        snap_installed_src=jnp.full((n,), -1, I32),
+        snap_installed_len=zn,
         next_cmd=jnp.asarray(0, I32),
         shadow_term=jnp.zeros((cap,), I32),
         shadow_val=jnp.zeros((cap,), I32),
+        shadow_base=jnp.asarray(0, I32),
         shadow_len=jnp.asarray(0, I32),
         violations=jnp.asarray(0, I32),
         first_violation_tick=jnp.asarray(-1, I32),
         first_leader_tick=jnp.asarray(-1, I32),
         msg_count=jnp.asarray(0, I32),
+        snap_install_count=jnp.asarray(0, I32),
     )

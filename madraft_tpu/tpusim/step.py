@@ -2,9 +2,9 @@
 
 This is the batched re-imagination of the reference's per-node async tick
 (/root/reference/src/raft/raft.rs: election timer 260-263, RequestVote fan-out
-266-293, RPC handlers 213-233) plus the simulator semantics it runs on
-(SURVEY.md §2.6): per-message loss/latency draws, pairwise partitions, kill/restart
-with persistent state, message counting.
+266-293, RPC handlers 213-233, snapshot path 149-168) plus the simulator
+semantics it runs on (SURVEY.md §2.6): per-message loss/latency draws, pairwise
+partitions, kill/restart with persistent state, message counting.
 
 Phase order within a tick (this ordering gives persist-before-send for free — all
 sends are computed from post-update persistent arrays, mirroring the reference's
@@ -12,16 +12,22 @@ sends are computed from post-update persistent arrays, mirroring the reference's
 
   1. faults     — crash / restart / repartition draws
   2. deliver    — process every mailbox slot due this tick (sequential over sources
-                  for per-node sequential semantics; vectorized over destinations)
+                  for per-node sequential semantics; vectorized over destinations):
+                  install-snapshot triggers first, then AE/RV requests/responses
   3. timers     — election timeouts -> candidacy + RequestVote broadcast;
                   client command injection at leaders; leader heartbeat ->
-                  AppendEntries broadcast with entries from next_idx
+                  AppendEntries (or install-snapshot for peers behind the
+                  leader's snapshot boundary) with entries from next_idx
   4. commit     — leader advances commit via majority-match (current-term rule)
-  5. oracle     — safety invariant reductions (election safety, log matching,
+  5. compact    — discard the window prefix up to the compaction boundary
+                  (commit, or the service layer's apply cursor)
+  6. oracle     — safety invariant reductions (election safety, log matching,
                   commit durability) + liveness/stat bookkeeping
 
-Control flow divergence across the batch is handled with masked updates
-(`jnp.where`) throughout; loops are only over the (static, tiny) node and
+The log is a WINDOW (see state.py): `base` is the snapshot boundary, slot k
+holds absolute index base+k+1, `log_len`/`commit`/next/match indices are
+absolute. Control-flow divergence across the batch is handled with masked
+updates (`jnp.where`); loops are only over the (static, tiny) node and
 entry-batch axes, so XLA sees fully static shapes.
 """
 
@@ -45,6 +51,8 @@ from madraft_tpu.tpusim.state import ClusterState, I32
 _S_FAULT, _S_RVREQ, _S_AEREQ, _S_TIMER, _S_CLIENT, _S_HB, _S_GRANT, _S_AERESET = (
     0, 1, 2, 3, 4, 5, 6, 7,
 )
+_S_SNREQ = 12
+_S_SNRESET = 13
 
 
 def _timeout_draw(cfg: SimConfig, key: jax.Array, shape) -> jax.Array:
@@ -61,10 +69,24 @@ def _net_draws(cfg: SimConfig, key: jax.Array, shape):
     return delay, lost
 
 
-def _row_term(log_term: jax.Array, pos: jax.Array, cap: int) -> jax.Array:
-    """log_term[i, pos[i]] with clipped gather; callers mask invalid positions."""
-    n = log_term.shape[0]
-    return log_term[jnp.arange(n), jnp.clip(pos, 0, cap - 1)]
+def _row_gather(arr: jax.Array, pos: jax.Array, cap: int) -> jax.Array:
+    """arr[i, pos[i]] with clipped gather; callers mask invalid positions."""
+    n = arr.shape[0]
+    return arr[jnp.arange(n), jnp.clip(pos, 0, cap - 1)]
+
+
+def _term_at(log_term, snap_term, base, abs_idx, cap):
+    """Term of absolute (1-based) index abs_idx per node; snap_term at the
+    boundary itself. Callers mask positions outside (base, log_len]."""
+    slot = abs_idx - base - 1
+    return jnp.where(abs_idx <= base, snap_term, _row_gather(log_term, slot, cap))
+
+
+def _shift_rows(arr: jax.Array, delta: jax.Array, cap: int) -> jax.Array:
+    """Per-row left shift: out[i, k] = arr[i, k + delta[i]] (clipped gather)."""
+    k = jnp.arange(cap, dtype=I32)[None, :]
+    idx = jnp.clip(k + delta[:, None], 0, cap - 1)
+    return jnp.take_along_axis(arr, idx, axis=1)
 
 
 def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> ClusterState:
@@ -84,12 +106,14 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     crash = crash_draw & (jnp.cumsum(crash_draw.astype(I32)) <= budget)
     alive = (s.alive | restart) & ~crash
 
-    # Restart = recovery from persisted state (term/voted_for/log survive; the
-    # volatile set resets — raft.rs:194-211 restore(), tester.rs:284-327).
+    # Restart = recovery from persisted state (term/voted_for/log/base survive;
+    # the volatile set resets — raft.rs:194-211 restore(), tester.rs:284-327).
+    # The snapshot covers 1..base, so commit restarts at base, not 0.
     role = jnp.where(restart, FOLLOWER, s.role)
     timer = jnp.where(restart, _timeout_draw(cfg, kf[2], (n,)), s.timer)
     hb = jnp.where(restart, 0, s.hb)
-    commit = jnp.where(restart, 0, s.commit)
+    commit = jnp.where(restart, s.base, s.commit)
+    compact_floor = jnp.where(restart, s.base, s.compact_floor)
     votes = jnp.where(restart[:, None], False, s.votes)
     next_idx = jnp.where(restart[:, None], 1, s.next_idx)
     match_idx = jnp.where(restart[:, None], 0, s.match_idx)
@@ -105,10 +129,54 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
 
     term, voted_for = s.term, s.voted_for
     log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
+    base, snap_term = s.base, s.snap_term
     rv_rsp_t, rv_rsp_term, rv_rsp_granted = s.rv_rsp_t, s.rv_rsp_term, s.rv_rsp_granted
     ae_rsp_t, ae_rsp_term = s.ae_rsp_t, s.ae_rsp_term
     ae_rsp_success, ae_rsp_match = s.ae_rsp_success, s.ae_rsp_match
     delivered = jnp.asarray(0, I32)
+    snap_installed_src = jnp.full((n,), -1, I32)
+    snap_installed_len = jnp.zeros((n,), I32)
+    snap_install_count = s.snap_install_count
+
+    # ------------------------------------------- deliver: install-snapshot
+    # Payload (boundary, snapshot term, service state) is the sender's live
+    # snapshot at delivery; a dead sender = a lost message (state.py
+    # rationale). The message's LEADER term deposes stale leaders exactly
+    # like AE/RV traffic, and only the current term's leader may install.
+    k_snreset = jax.random.fold_in(key, _S_SNRESET)
+    for src in range(n):
+        arr = (s.sn_req_t[:, src] == t) & alive & alive[src]
+        delivered += jnp.sum(arr, dtype=I32)
+        mterm = s.sn_req_term[:, src]
+        higher = arr & (mterm > term)
+        term = jnp.where(higher, mterm, term)
+        role = jnp.where(higher, FOLLOWER, role)
+        voted_for = jnp.where(higher, -1, voted_for)
+        acc = arr & (mterm == term)
+        role = jnp.where(acc & (role == CANDIDATE), FOLLOWER, role)
+        timer = jnp.where(  # current-leader contact resets the election timer
+            acc, _timeout_draw(cfg, jax.random.fold_in(k_snreset, src), (n,)), timer
+        )
+        slen = s.base[src]
+        sterm_snap = s.snap_term[src]
+        # cond_install (raft.rs:153): ignore a snapshot behind our commit.
+        inst = acc & (slen > commit)
+        # keep a matching suffix (conditional install); otherwise discard log
+        keep = inst & (log_len > slen) & (
+            _term_at(log_term, snap_term, base, slen, cap) == sterm_snap
+        )
+        delta = jnp.where(inst, jnp.maximum(slen - base, 0), 0)
+        log_term = jnp.where(inst[:, None], _shift_rows(log_term, delta, cap), log_term)
+        log_val = jnp.where(inst[:, None], _shift_rows(log_val, delta, cap), log_val)
+        log_len = jnp.where(inst, jnp.where(keep, log_len, slen), log_len)
+        base = jnp.where(inst, slen, base)
+        snap_term = jnp.where(inst, sterm_snap, snap_term)
+        commit = jnp.where(inst, jnp.maximum(commit, slen), commit)
+        compact_floor = jnp.where(inst, slen, compact_floor)
+        snap_installed_src = jnp.where(inst, src, snap_installed_src)
+        snap_installed_len = jnp.where(inst, slen, snap_installed_len)
+        snap_install_count += jnp.sum(inst, dtype=I32)
+    sn_req_t = jnp.where(s.sn_req_t == t, 0, s.sn_req_t)
 
     # ----------------------------------------------------- deliver: RV requests
     k_grant = jax.random.fold_in(key, _S_GRANT)
@@ -120,7 +188,9 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         term = jnp.where(higher, mterm, term)
         role = jnp.where(higher, FOLLOWER, role)
         voted_for = jnp.where(higher, -1, voted_for)
-        my_llt = jnp.where(log_len > 0, _row_term(log_term, log_len - 1, cap), 0)
+        my_llt = jnp.where(
+            log_len > base, _row_gather(log_term, log_len - base - 1, cap), snap_term
+        )
         log_ok = (s.rv_req_llt[:, src] > my_llt) | (
             (s.rv_req_llt[:, src] == my_llt) & (s.rv_req_lli[:, src] >= log_len)
         )
@@ -153,26 +223,33 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
             acc, _timeout_draw(cfg, jax.random.fold_in(k_aereset, src), (n,)), timer
         )
         prev = s.ae_req_prev[:, src]
-        prev_ok = (prev == 0) | (
-            (prev <= log_len) & (_row_term(log_term, prev - 1, cap) == s.ae_req_prev_term[:, src])
+        # prev at-or-below our snapshot boundary is committed => matches by
+        # definition; otherwise the terms must agree (log-matching check).
+        prev_ok = (prev <= log_len) & (
+            (prev <= base)
+            | (_term_at(log_term, snap_term, base, prev, cap)
+               == s.ae_req_prev_term[:, src])
         )
         success = acc & prev_ok
         nent = s.ae_req_n[:, src]
         conflict_any = jnp.zeros((n,), jnp.bool_)
         for e in range(ae_max):
-            idx = prev + e  # 0-based slot of this batch entry
-            in_batch = success & (e < nent) & (idx < cap)
+            abs_idx = prev + e + 1          # 1-based absolute index of entry e
+            slot = abs_idx - base - 1       # window slot
+            in_batch = success & (e < nent) & (slot >= 0) & (slot < cap)
             ent_t = s.ae_req_ent_term[:, src, e]
             ent_v = s.ae_req_ent_val[:, src, e]
-            conflict_any |= in_batch & (idx < log_len) & (_row_term(log_term, idx, cap) != ent_t)
-            slot = jnp.clip(idx, 0, cap - 1)
-            log_term = log_term.at[me, slot].set(
-                jnp.where(in_batch, ent_t, log_term[me, slot])
+            conflict_any |= in_batch & (abs_idx <= log_len) & (
+                _row_gather(log_term, slot, cap) != ent_t
             )
-            log_val = log_val.at[me, slot].set(
-                jnp.where(in_batch, ent_v, log_val[me, slot])
+            cslot = jnp.clip(slot, 0, cap - 1)
+            log_term = log_term.at[me, cslot].set(
+                jnp.where(in_batch, ent_t, log_term[me, cslot])
             )
-        batch_end = jnp.clip(prev + nent, 0, cap)
+            log_val = log_val.at[me, cslot].set(
+                jnp.where(in_batch, ent_v, log_val[me, cslot])
+            )
+        batch_end = jnp.minimum(prev + nent, base + cap)  # window overflow: drop tail
         # Conflict => truncate to the rewritten batch; otherwise never shrink
         # (a heartbeat must not drop entries a newer AE already appended).
         log_len = jnp.where(
@@ -182,16 +259,16 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         )
         commit = jnp.where(
             success,
-            jnp.maximum(commit, jnp.minimum(s.ae_req_commit[:, src], prev + nent)),
+            jnp.maximum(commit, jnp.minimum(s.ae_req_commit[:, src], batch_end)),
             commit,
         )
         # Failure hint for fast backtracking (term-skip): first index of the
         # conflicting term, or our log length if the leader's prev is past our end.
         over = prev > log_len
-        conf_term = _row_term(log_term, prev - 1, cap)
-        first_of_term = jnp.argmax(log_term == conf_term[:, None], axis=1).astype(I32)
-        hint = jnp.where(over, log_len, first_of_term)
-        rsp_match = jnp.where(success, prev + nent, hint)
+        conf_term = _term_at(log_term, snap_term, base, prev, cap)
+        first_slot = jnp.argmax(log_term == conf_term[:, None], axis=1).astype(I32)
+        hint = jnp.where(over, log_len, jnp.maximum(base + first_slot, base))
+        rsp_match = jnp.where(success, batch_end, hint)
         delay, lost = _net_draws(cfg, jax.random.fold_in(jax.random.fold_in(key, _S_AEREQ), src), (n,))
         send = arr & adj[:, src] & ~lost
         ae_rsp_t = ae_rsp_t.at[src, :].set(jnp.where(send, t + delay, ae_rsp_t[src, :]))
@@ -259,7 +336,9 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     votes = jnp.where(fired[:, None], eye, votes)
     timer = jnp.where(fired, _timeout_draw(cfg, kt[0], (n,)), timer)
 
-    llt = jnp.where(log_len > 0, _row_term(log_term, log_len - 1, cap), 0)
+    llt = jnp.where(
+        log_len > base, _row_gather(log_term, log_len - base - 1, cap), snap_term
+    )
     delay, lost = _net_draws(cfg, kt[1], (n, n))
     send_rv = fired[None, :] & ~eye & adj.T & ~lost  # [dst, src], link src->dst
     rv_req_t = jnp.where(send_rv, t + delay, rv_req_t)
@@ -272,9 +351,9 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     inject = (
         lead
         & jax.random.bernoulli(jax.random.fold_in(key, _S_CLIENT), cfg.p_client_cmd, (n,))
-        & (log_len < cap)
+        & (log_len - base < cap)
     )
-    slot = jnp.clip(log_len, 0, cap - 1)
+    slot = jnp.clip(log_len - base, 0, cap - 1)
     cmd_val = s.next_cmd * n + me + 1  # unique within the cluster, never 0
     log_term = log_term.at[me, slot].set(jnp.where(inject, term, log_term[me, slot]))
     log_val = log_val.at[me, slot].set(jnp.where(inject, cmd_val, log_val[me, slot]))
@@ -285,20 +364,27 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     hb = jnp.where(lead, hb - 1, hb)
     fire_hb = lead & (hb <= 0)
     hb = jnp.where(fire_hb, cfg.heartbeat_ticks, hb)
+    # A peer behind the leader's snapshot boundary gets an install-snapshot
+    # trigger instead of entries (raft.rs:159 InstallSnapshot).
+    need_snap = next_idx.T <= base[None, :]  # [dst, src]
     prev_m = next_idx.T - 1  # [dst, src]: src's prev index for dst
     n_m = jnp.clip(log_len[None, :] - prev_m, 0, ae_max)
-    idxs = prev_m[:, :, None] + jnp.arange(ae_max, dtype=I32)[None, None, :]
+    # entry e for (dst, src): src window slot (prev - base_src) + e
+    slot0 = prev_m - base[None, :]
+    idxs = slot0[:, :, None] + jnp.arange(ae_max, dtype=I32)[None, None, :]
     log_t_b = jnp.broadcast_to(log_term[None, :, :], (n, n, cap))
     log_v_b = jnp.broadcast_to(log_val[None, :, :], (n, n, cap))
     ent_t = jnp.take_along_axis(log_t_b, jnp.clip(idxs, 0, cap - 1), axis=2)
     ent_v = jnp.take_along_axis(log_v_b, jnp.clip(idxs, 0, cap - 1), axis=2)
     prev_term_m = jnp.where(
-        prev_m > 0,
-        jnp.take_along_axis(log_t_b, jnp.clip(prev_m - 1, 0, cap - 1)[:, :, None], axis=2)[:, :, 0],
-        0,
+        prev_m > base[None, :],
+        jnp.take_along_axis(
+            log_t_b, jnp.clip(slot0 - 1, 0, cap - 1)[:, :, None], axis=2
+        )[:, :, 0],
+        snap_term[None, :],
     )
     delay, lost = _net_draws(cfg, jax.random.fold_in(key, _S_HB), (n, n))
-    send_ae = fire_hb[None, :] & ~eye & adj.T & ~lost
+    send_ae = fire_hb[None, :] & ~eye & adj.T & ~lost & ~need_snap
     ae_req_t = jnp.where(send_ae, t + delay, ae_req_t)
     ae_req_term = jnp.where(send_ae, term[None, :], s.ae_req_term)
     ae_req_prev = jnp.where(send_ae, prev_m, s.ae_req_prev)
@@ -307,11 +393,19 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     ae_req_commit = jnp.where(send_ae, commit[None, :], s.ae_req_commit)
     ae_req_ent_term = jnp.where(send_ae[:, :, None], ent_t, s.ae_req_ent_term)
     ae_req_ent_val = jnp.where(send_ae[:, :, None], ent_v, s.ae_req_ent_val)
+    delay_sn, lost_sn = _net_draws(cfg, jax.random.fold_in(key, _S_SNREQ), (n, n))
+    send_sn = fire_hb[None, :] & ~eye & adj.T & ~lost_sn & need_snap
+    sn_req_t = jnp.where(send_sn, t + delay_sn, sn_req_t)
+    sn_req_term = jnp.where(send_sn, term[None, :], s.sn_req_term)
+    # advance next_idx past the snapshot on send (retried via hints if lost)
+    next_idx = jnp.where(send_sn.T, base[:, None] + 1, next_idx)
 
     # ------------------------------------------------------------ commit advance
     mi = match_idx.at[me, me].set(log_len)
     kth = -jnp.sort(-mi, axis=1)[:, cfg.majority - 1]  # majority-th largest match
-    cur_term_ok = (kth > 0) & (_row_term(log_term, kth - 1, cap) == term)
+    cur_term_ok = (kth > base) & (
+        _term_at(log_term, snap_term, base, kth, cap) == term
+    )
     commit = jnp.where(lead & cur_term_ok, jnp.maximum(commit, kth), commit)
 
     # ------------------------------------------------------------------- oracle
@@ -322,28 +416,56 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         is_lead[:, None] & is_lead[None, :] & ~eye & (term[:, None] == term[None, :])
     )
     viol |= jnp.where(jnp.any(dual), VIOLATION_DUAL_LEADER, 0)
-    # Log matching: same (index, term) => identical prefix (includes crashed nodes'
-    # persisted logs — the property holds for all logs at all times).
-    ks_ = jnp.arange(cap)
-    both = ks_[None, None, :] < jnp.minimum(log_len[:, None], log_len[None, :])[:, :, None]
-    tmatch = both & (log_term[:, None, :] == log_term[None, :, :])
-    eq = tmatch & (log_val[:, None, :] == log_val[None, :, :])
+    # Log matching: same (index, term) => identical prefix, over the window
+    # overlap of each pair (entries below either base are committed and are
+    # covered by the shadow oracle). Align j's window onto i's slots.
+    ks_ = jnp.arange(cap, dtype=I32)
+    abs_i = base[:, None, None] + ks_[None, None, :] + 1          # [i, 1, k]
+    j_slot = abs_i - base[None, :, None] - 1                      # [i, j, k]
+    log_t_bj = jnp.broadcast_to(log_term[None, :, :], (n, n, cap))
+    log_v_bj = jnp.broadcast_to(log_val[None, :, :], (n, n, cap))
+    term_j = jnp.take_along_axis(log_t_bj, jnp.clip(j_slot, 0, cap - 1), axis=2)
+    val_j = jnp.take_along_axis(log_v_bj, jnp.clip(j_slot, 0, cap - 1), axis=2)
+    both = (
+        (abs_i <= jnp.minimum(log_len[:, None], log_len[None, :])[:, :, None])
+        & (j_slot >= 0) & (j_slot < cap)
+    )
+    tmatch = both & (log_term[:, None, :] == term_j)
+    eq = tmatch & (log_val[:, None, :] == val_j)
     pref = jnp.cumprod((eq | ~both).astype(I32), axis=2).astype(jnp.bool_)
     viol |= jnp.where(jnp.any(tmatch & ~pref), VIOLATION_LOG_MATCHING, 0)
     # Commit durability: every entry any node ever committed is recorded in a
-    # shadow log; later commits must agree (catches Figure-8-style commit loss;
-    # the online analogue of StorageHandle.push_and_check, tester.rs:379-397).
-    shadow_term, shadow_val, shadow_len = s.shadow_term, s.shadow_val, s.shadow_len
+    # windowed shadow log; later commits must agree (catches Figure-8-style
+    # commit loss; the online analogue of push_and_check, tester.rs:379-397).
+    shadow_term, shadow_val = s.shadow_term, s.shadow_val
+    shadow_base, shadow_len = s.shadow_base, s.shadow_len
+    # slide the shadow window so the largest commit fits
+    need = jnp.max(jnp.where(alive, commit, 0))
+    sh_delta = jnp.maximum(need - cap - shadow_base, 0)
+    shadow_term = jnp.where(
+        sh_delta > 0,
+        jnp.take(shadow_term, jnp.clip(ks_ + sh_delta, 0, cap - 1)),
+        shadow_term,
+    )
+    shadow_val = jnp.where(
+        sh_delta > 0,
+        jnp.take(shadow_val, jnp.clip(ks_ + sh_delta, 0, cap - 1)),
+        shadow_val,
+    )
+    shadow_base = shadow_base + sh_delta
     for i in range(n):
         c = commit[i]
-        known = ks_ < jnp.minimum(c, shadow_len)
-        differ = known & (
-            (shadow_term != log_term[i]) | (shadow_val != log_val[i])
-        )
+        abs_k = shadow_base + ks_ + 1                 # shadow slot k's index
+        i_slot = abs_k - base[i] - 1
+        vis = (i_slot >= 0) & (i_slot < cap)
+        node_t = jnp.take(log_term[i], jnp.clip(i_slot, 0, cap - 1))
+        node_v = jnp.take(log_val[i], jnp.clip(i_slot, 0, cap - 1))
+        known = vis & (abs_k <= jnp.minimum(c, shadow_len))
+        differ = known & ((shadow_term != node_t) | (shadow_val != node_v))
         viol |= jnp.where(jnp.any(differ), VIOLATION_COMMIT_SHADOW, 0)
-        new = (ks_ >= shadow_len) & (ks_ < c)
-        shadow_term = jnp.where(new, log_term[i], shadow_term)
-        shadow_val = jnp.where(new, log_val[i], shadow_val)
+        new = vis & (abs_k > shadow_len) & (abs_k <= c)
+        shadow_term = jnp.where(new, node_t, shadow_term)
+        shadow_val = jnp.where(new, node_v, shadow_val)
         shadow_len = jnp.maximum(shadow_len, c)
 
     violations = s.violations | viol
@@ -354,10 +476,26 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         (s.first_leader_tick < 0) & jnp.any(is_lead), t, s.first_leader_tick
     )
 
+    # -------------------------------------------------------------- compaction
+    # AFTER the oracle on purpose: the shadow must record entries committed
+    # this tick before the window discards them. Snapshot through the boundary
+    # (commit, or the service layer's apply cursor) once compact_every entries
+    # accumulated past base. Service layers observe base advancing and capture
+    # their own state (kv.py); for pure raft the shadow is the only consumer.
+    boundary = commit if cfg.compact_at_commit else jnp.minimum(compact_floor, commit)
+    do_compact = alive & (boundary - base >= cfg.compact_every)
+    delta = jnp.where(do_compact, boundary - base, 0)
+    new_snap_term = _term_at(log_term, snap_term, base, boundary, cap)
+    log_term = jnp.where(do_compact[:, None], _shift_rows(log_term, delta, cap), log_term)
+    log_val = jnp.where(do_compact[:, None], _shift_rows(log_val, delta, cap), log_val)
+    snap_term = jnp.where(do_compact, new_snap_term, snap_term)
+    base = jnp.where(do_compact, boundary, base)
+
     return ClusterState(
         tick=t,
         term=term, voted_for=voted_for, role=role, timer=timer, hb=hb, alive=alive,
-        log_term=log_term, log_val=log_val, log_len=log_len, commit=commit,
+        log_term=log_term, log_val=log_val, log_len=log_len,
+        base=base, snap_term=snap_term, commit=commit, compact_floor=compact_floor,
         votes=votes, next_idx=next_idx, match_idx=match_idx, adj=adj,
         rv_req_t=rv_req_t, rv_req_term=rv_req_term,
         rv_req_lli=rv_req_lli, rv_req_llt=rv_req_llt,
@@ -368,9 +506,15 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         ae_req_ent_term=ae_req_ent_term, ae_req_ent_val=ae_req_ent_val,
         ae_rsp_t=ae_rsp_t, ae_rsp_term=ae_rsp_term,
         ae_rsp_success=ae_rsp_success, ae_rsp_match=ae_rsp_match,
+        sn_req_t=sn_req_t,
+        sn_req_term=sn_req_term,
+        snap_installed_src=snap_installed_src,
+        snap_installed_len=snap_installed_len,
         next_cmd=next_cmd,
-        shadow_term=shadow_term, shadow_val=shadow_val, shadow_len=shadow_len,
+        shadow_term=shadow_term, shadow_val=shadow_val,
+        shadow_base=shadow_base, shadow_len=shadow_len,
         violations=violations, first_violation_tick=first_violation_tick,
         first_leader_tick=first_leader_tick,
         msg_count=s.msg_count + delivered,
+        snap_install_count=snap_install_count,
     )

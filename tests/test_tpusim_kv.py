@@ -21,6 +21,7 @@ from madraft_tpu.tpusim.kv import (
 BASE = SimConfig(
     n_nodes=5,
     p_client_cmd=0.0,  # the KV layer owns injection
+    compact_at_commit=False,  # the KV layer drives the compaction boundary
     loss_prob=0.1,
     p_crash=0.01,
     p_restart=0.2,
