@@ -1,0 +1,63 @@
+"""Locate the hot phase of step_cluster by early-return surgery on its source."""
+import functools, time, sys, types, pathlib
+import jax, jax.numpy as jnp, numpy as np
+
+SRC = pathlib.Path("/root/repo/madraft_tpu/tpusim/step.py").read_text()
+
+# Anchor = line that starts a section; we insert an early return just before it.
+RETURN = (
+    "    return s._replace(tick=t, term=term, voted_for=voted_for, role=role,\n"
+    "        timer=timer, hb=hb, alive=alive, adj=adj, log_term=log_term,\n"
+    "        log_val=log_val, log_len=log_len, base=base, snap_term=snap_term,\n"
+    "        commit=commit, votes=votes, next_idx=next_idx, match_idx=match_idx)\n"
+)
+ANCHORS = [
+    ("faults-only", "    # ------------------------------------------- deliver: install-snapshot"),
+    ("+sn-deliver", "    # ----------------------------------------------------- deliver: RV requests"),
+    ("+rv-deliver", "    # ----------------------------------------------------- deliver: AE requests"),
+    ("+ae-deliver", "    # ---------------------------------------------------- deliver: RV responses"),
+    ("+responses", "    # ------------------------------------------------- timers: election timeout"),
+    ("+timers", "    # --------------------------------------- client command injection at leaders"),
+    ("+inject", "    # -------------------------------------------- leader heartbeat / replication"),
+    ("+heartbeat", "    # ------------------------------------------------------------ commit advance"),
+    ("+commit", "    # ------------------------------------------------------------------- oracle"),
+    ("+oracle", "    # -------------------------------------------------------------- compaction"),
+]
+
+def make_step(cut_anchor):
+    src = SRC
+    if cut_anchor is not None:
+        i = src.index(cut_anchor)
+        src = src[:i] + RETURN
+    mod = types.ModuleType("step_var")
+    mod.__dict__["__name__"] = "step_var"
+    exec(compile(src, "step_var.py", "exec"), mod.__dict__)
+    return mod.step_cluster
+
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.state import init_cluster
+
+cfg = SimConfig(n_nodes=5, p_client_cmd=0.2, loss_prob=0.1, p_crash=0.01,
+                p_restart=0.2, max_dead=2, p_repartition=0.02, p_heal=0.05)
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+L = 16
+base = jax.random.PRNGKey(0)
+keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(N))
+states = jax.block_until_ready(jax.vmap(functools.partial(init_cluster, cfg))(keys))
+
+names = [n for n, _ in ANCHORS] + ["full"]
+cuts = [a for _, a in ANCHORS] + [None]
+prev = 0.0
+for name, cut in zip(names, cuts):
+    step = make_step(cut)
+    @jax.jit
+    def run(states, keys, step=step):
+        def body(c, _):
+            return jax.vmap(functools.partial(step, cfg))(c, keys), None
+        final, _ = jax.lax.scan(body, states, None, length=L)
+        return final
+    out = run(states, keys); _ = np.asarray(out.tick)  # compile+run+fetch
+    t0 = time.time(); out = run(states, keys); _ = np.asarray(out.tick)
+    dt = (time.time() - t0) / L * 1e3
+    print(f"{name:12s} {dt:8.2f} ms/tick  (delta {dt-prev:+8.2f})", flush=True)
+    prev = dt

@@ -3,6 +3,22 @@
 North star (BASELINE.json): >=100k 5-node cluster-steps/sec/chip with zero safety
 violations. Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Methodology (round-2, after the round-1 postmortem):
+- The tunnel platform's block_until_ready does NOT block, so every timed
+  region ends with a device->host fetch of the violation bitmap — the only
+  honest sync point.
+- The tick scan is chunked (host loop over compiled CHUNK-tick scans) so a
+  single device execution stays well under the tunnel's per-call deadline —
+  the round-1 "TPU device error" at 16k clusters was a >60 s single execution,
+  not a kernel bug.
+- The timed region is whole fuzz runs repeated until >=1 s of wall time (at
+  least 2 runs); the reported value is the best run, and the spread across
+  runs is reported so back-to-back agreement is visible.
+- hbm_util_floor is a lower-bound utilization proxy: each tick must read and
+  write the cluster state at least once, so (2 * state_bytes * ticks) / time
+  relative to the chip's HBM peak bounds how far from memory-roofline the
+  step function runs.
 """
 
 import json
@@ -11,17 +27,18 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from madraft_tpu.tpusim import SimConfig
-from madraft_tpu.tpusim.engine import make_fuzz_fn, report
+from madraft_tpu.tpusim import SimConfig, init_cluster, step_cluster
+from madraft_tpu.tpusim.engine import report
 
 BASELINE_STEPS_PER_SEC = 100_000.0  # BASELINE.json north star
+HBM_PEAK_BYTES_PER_S = 819e9        # TPU v5e; proxy denominator only
+CHUNK_TICKS = 64                    # one device execution = one chunk
 
 
-def main() -> None:
-    n_clusters = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
-    n_ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 512
-    cfg = SimConfig(
+def flagship_config() -> SimConfig:
+    return SimConfig(
         n_nodes=5,
         p_client_cmd=0.2,
         loss_prob=0.1,
@@ -31,14 +48,55 @@ def main() -> None:
         p_repartition=0.02,
         p_heal=0.05,
     )
-    fn = make_fuzz_fn(cfg, n_clusters, n_ticks)
-    seed = jnp.asarray(12345, jnp.uint32)
-    jax.block_until_ready(fn(seed))  # compile + warm-up
-    t0 = time.perf_counter()
-    final = jax.block_until_ready(fn(seed))
-    dt = time.perf_counter() - t0
+
+
+def main() -> None:
+    n_clusters = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+    n_ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 512
+    cfg = flagship_config()
+    import functools
+
+    @jax.jit
+    def init(seed):
+        base = jax.random.PRNGKey(seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(n_clusters)
+        )
+        return jax.vmap(functools.partial(init_cluster, cfg))(keys), keys
+
+    @jax.jit
+    def chunk(states, keys):
+        def body(c, _):
+            return jax.vmap(functools.partial(step_cluster, cfg))(c, keys), None
+        final, _ = jax.lax.scan(body, states, None, length=CHUNK_TICKS)
+        return final
+
+    n_chunks = max(1, n_ticks // CHUNK_TICKS)
+
+    def run(seed: int):
+        states, keys = init(jnp.asarray(seed, jnp.uint32))
+        for _ in range(n_chunks):
+            states = chunk(states, keys)
+        return states
+
+    # compile + warm-up; the fetch is the sync point (tunnel caveat above)
+    final = run(12345)
+    _ = np.asarray(final.violations)
+
+    times = []
+    while sum(times) < 1.0 or len(times) < 2:
+        t0 = time.perf_counter()
+        final = run(12345)
+        viol = np.asarray(final.violations)
+        times.append(time.perf_counter() - t0)
     rep = report(final)
-    steps_per_sec = n_clusters * n_ticks / dt
+    best = min(times)
+    steps = n_chunks * CHUNK_TICKS * n_clusters
+    steps_per_sec = steps / best
+    spread = (max(times) - min(times)) / best
+    state_bytes = sum(x.nbytes for x in jax.tree.leaves(final))
+    hbm_floor = 2 * state_bytes * n_chunks * CHUNK_TICKS / best / HBM_PEAK_BYTES_PER_S
+
     print(
         json.dumps(
             {
@@ -48,9 +106,12 @@ def main() -> None:
                 "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
                 "detail": {
                     "n_clusters": n_clusters,
-                    "n_ticks": n_ticks,
-                    "wall_s": round(dt, 3),
-                    "violations": int(rep.n_violating),
+                    "n_ticks": n_chunks * CHUNK_TICKS,
+                    "runs": len(times),
+                    "best_wall_s": round(best, 3),
+                    "run_spread": round(spread, 3),
+                    "hbm_util_floor": round(hbm_floor, 4),
+                    "violations": int((viol != 0).sum()),
                     "clusters_with_commits": int((rep.committed > 0).sum()),
                     "device": str(jax.devices()[0]),
                 },

@@ -20,8 +20,13 @@ class SimConfig:
     """Static parameters of one batched simulation. All times are in ticks."""
 
     n_nodes: int = 5
-    log_cap: int = 64        # window capacity: entries retained past the snapshot
+    log_cap: int = 64        # ring capacity: entries retained past the snapshot
+    #                          (power of two: canonical lane = (index-1) & (cap-1))
     ae_max: int = 4          # max entries carried per AppendEntries message
+
+    def __post_init__(self):
+        if self.log_cap & (self.log_cap - 1):
+            raise ValueError(f"log_cap must be a power of two, got {self.log_cap}")
 
     # Log compaction (the Lab 2D snapshot path, raft.rs:149-168): a node
     # discards its window prefix up to the compaction boundary every
@@ -71,7 +76,8 @@ class SimConfig:
         return dataclasses.replace(self, **kw)
 
 
-# Violation bitmask values (oracle reductions; see invariants.py).
+# Violation bitmask values (oracle reductions; raft oracles live in step.py,
+# service-layer oracles extend these in kv.py).
 VIOLATION_DUAL_LEADER = 1      # two live leaders share a term (election safety)
 VIOLATION_LOG_MATCHING = 2     # same (index, term) but diverging entries/prefix
 VIOLATION_COMMIT_SHADOW = 4    # a committed entry changed or was lost (durability)

@@ -47,7 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim.config import LEADER, SimConfig
 from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
-from madraft_tpu.tpusim.step import step_cluster
+from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 
 # Additional violation bits (extending config.VIOLATION_*).
 VIOLATION_EXACTLY_ONCE = 8   # duplicate or out-of-order apply of a client op
@@ -179,44 +179,52 @@ def kv_step(
     # 3. Install-snapshot this tick: adopt the sender's persisted snapshot
     #    (its pre-tick snap tables match the pre-tick base the trigger
     #    carried) as both live and persisted state; jump the cursor.
-    src = jnp.clip(s.snap_installed_src, 0, n - 1)
+    #    One-hot over the (tiny) node axis instead of a dynamic row gather.
+    src_oh = (me[None, :] == s.snap_installed_src[:, None])[:, :, None]  # [dst, src, 1]
+
+    def _adopt(snap):
+        return jnp.sum(jnp.where(src_oh, snap[None, :, :], 0), axis=1)
+
+    ad_last_seq, ad_apply_count = _adopt(ks.snap_last_seq), _adopt(ks.snap_apply_count)
+    ad_key_hash, ad_key_count = _adopt(ks.snap_key_hash), _adopt(ks.snap_key_count)
     applied = jnp.where(inst, s.base, applied)
-    last_seq = jnp.where(inst[:, None], ks.snap_last_seq[src], last_seq)
-    apply_count = jnp.where(inst[:, None], ks.snap_apply_count[src], apply_count)
-    key_hash = jnp.where(inst[:, None], ks.snap_key_hash[src], key_hash)
-    key_count = jnp.where(inst[:, None], ks.snap_key_count[src], key_count)
-    snap_last_seq = jnp.where(inst[:, None], ks.snap_last_seq[src], snap_last_seq)
-    snap_apply_count = jnp.where(inst[:, None], ks.snap_apply_count[src], snap_apply_count)
-    snap_key_hash = jnp.where(inst[:, None], ks.snap_key_hash[src], snap_key_hash)
-    snap_key_count = jnp.where(inst[:, None], ks.snap_key_count[src], snap_key_count)
+    last_seq = jnp.where(inst[:, None], ad_last_seq, last_seq)
+    apply_count = jnp.where(inst[:, None], ad_apply_count, apply_count)
+    key_hash = jnp.where(inst[:, None], ad_key_hash, key_hash)
+    key_count = jnp.where(inst[:, None], ad_key_count, key_count)
+    snap_last_seq = jnp.where(inst[:, None], ad_last_seq, snap_last_seq)
+    snap_apply_count = jnp.where(inst[:, None], ad_apply_count, snap_apply_count)
+    snap_key_hash = jnp.where(inst[:, None], ad_key_hash, snap_key_hash)
+    snap_key_count = jnp.where(inst[:, None], ad_key_count, snap_key_count)
 
     # ---------------------------------------------------------- apply machines
+    # All row-indexed reads/writes are one-hot mask-reduces over the (tiny)
+    # lane axes — dynamic per-row gathers/scatters serialize on TPU.
     viol = jnp.asarray(0, I32)
     limit = s.log_len if kcfg.bug_apply_uncommitted else s.commit
+    lane = jnp.arange(cap, dtype=I32)[None, :]
+    cl_lane = jnp.arange(nc, dtype=I32)[None, :]
+    k_lane = jnp.arange(kcfg.n_keys, dtype=I32)[None, :]
     for _ in range(kcfg.apply_max):
         can = s.alive & (applied < limit)
-        pos = jnp.clip(applied - s.base, 0, cap - 1)  # window slot of applied+1
-        val = s.log_val[me, pos]
+        pos = _slot(applied + 1, cap)  # canonical ring lane of index applied+1
+        val = jnp.sum(jnp.where(lane == pos[:, None], s.log_val, 0), axis=-1)
         client, seq, k = _unpack(kcfg, val)
         client = jnp.clip(client, 0, nc - 1)
-        prev = last_seq[me, client]
+        cl_oh = cl_lane == client[:, None]            # [n, nc]
+        prev = jnp.sum(jnp.where(cl_oh, last_seq, 0), axis=-1)
         dup = seq <= prev
         # order oracle: a first-time seq must be exactly prev+1 (the clerk
         # starts s+1 only after s committed, so committed order is gap-free)
         viol |= jnp.where(jnp.any(can & ~dup & (seq > prev + 1)),
                           VIOLATION_EXACTLY_ONCE, 0)
         do = can if kcfg.bug_skip_dedup else (can & ~dup)
-        key_hash = key_hash.at[me, k].set(
-            jnp.where(do, key_hash[me, k] * 1000003 + val, key_hash[me, k])
-        )
-        key_count = key_count.at[me, k].set(
-            jnp.where(do, key_count[me, k] + 1, key_count[me, k])
-        )
-        apply_count = apply_count.at[me, client].set(
-            jnp.where(do, apply_count[me, client] + 1, apply_count[me, client])
-        )
-        last_seq = last_seq.at[me, client].set(
-            jnp.where(can, jnp.maximum(prev, seq), prev)
+        k_oh = (k_lane == k[:, None]) & do[:, None]   # [n, nk]
+        key_hash = jnp.where(k_oh, key_hash * 1000003 + val[:, None], key_hash)
+        key_count = jnp.where(k_oh, key_count + 1, key_count)
+        apply_count = jnp.where(cl_oh & do[:, None], apply_count + 1, apply_count)
+        last_seq = jnp.where(
+            cl_oh & can[:, None], jnp.maximum(prev, seq)[:, None], last_seq
         )
         applied = jnp.where(can, applied + 1, applied)
 
@@ -247,10 +255,9 @@ def kv_step(
     # log (ground truth of commits — the clerk's Ok reply). The shadow is a
     # window; a clerk polls every tick, far faster than the window slides.
     want = _pack(kcfg, jnp.arange(nc, dtype=I32), ks.clerk_seq, ks.clerk_key)
+    sh_live = _lane_abs(s.shadow_base, cap) <= s.shadow_len  # canonical ring
     in_shadow = jnp.any(
-        (s.shadow_val[None, :] == want[:, None])
-        & (jnp.arange(cap)[None, :] < s.shadow_len - s.shadow_base),
-        axis=1,
+        (s.shadow_val[None, :] == want[:, None]) & sh_live[None, :], axis=1
     )
     newly_acked = ks.clerk_out & in_shadow
     clerk_acked = jnp.where(newly_acked, ks.clerk_seq, ks.clerk_acked)
@@ -280,20 +287,19 @@ def kv_step(
     # is later overwritten — the rejoin_2b scenario).
     log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
     for c in range(nc):
-        tgt = target[c]
+        sel = me == target[c]                         # one-hot over nodes
         ok = (
-            retry[c]
-            & s.alive[tgt]
-            & (s.role[tgt] == LEADER)
-            & (log_len[tgt] - s.base[tgt] < cap)  # window has room
+            sel
+            & retry[c]
+            & s.alive
+            & (s.role == LEADER)
+            & (log_len - s.base < cap)  # window has room
         )
-        slot = jnp.clip(log_len[tgt] - s.base[tgt], 0, cap - 1)
         v = _pack(kcfg, jnp.asarray(c, I32), clerk_seq[c], clerk_key[c])
-        log_term = log_term.at[tgt, slot].set(
-            jnp.where(ok, s.term[tgt], log_term[tgt, slot])
-        )
-        log_val = log_val.at[tgt, slot].set(jnp.where(ok, v, log_val[tgt, slot]))
-        log_len = log_len.at[tgt].set(jnp.where(ok, log_len[tgt] + 1, log_len[tgt]))
+        hit = ok[:, None] & (lane == _slot(log_len + 1, cap)[:, None])
+        log_term = jnp.where(hit, s.term[:, None], log_term)
+        log_val = jnp.where(hit, v, log_val)
+        log_len = jnp.where(ok, log_len + 1, log_len)
 
     raft = s._replace(
         log_term=log_term,

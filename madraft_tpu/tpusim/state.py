@@ -14,12 +14,15 @@ Design notes (vs the reference, SURVEY.md §2.6/§7):
   (/root/reference/src/raft/tester.rs:127-137): each directed (dst, src) pair has one
   slot per message type with a delivery tick; overwriting an undelivered slot models
   packet loss (counted faithfully as Raft must tolerate it).
-- Log indices are 1-based as in Raft. The log array is a WINDOW: ``base`` is the
-  snapshot boundary (indices 1..base are compacted away), slot k holds absolute
-  index ``base + k + 1``, and ``log_len`` / ``commit`` stay ABSOLUTE (highest
-  index present / committed). ``snap_term`` is the term at index ``base``.
-  Compaction shifts the window left; an install-snapshot adopts a peer's
-  boundary. This is what lets fuzz histories run far past ``log_cap``
+- Log indices are 1-based as in Raft. The log array is a CANONICAL RING:
+  absolute index ``a`` always lives in lane ``(a - 1) mod log_cap``, ``base`` is
+  the snapshot boundary (indices 1..base are compacted away; the live window is
+  ``(base, base + log_cap]``), and ``log_len`` / ``commit`` stay ABSOLUTE
+  (highest index present / committed). ``snap_term`` is the term at index
+  ``base``. Because an index's lane never changes, compaction and
+  install-snapshot are pure ``base`` bumps — no data movement — and every
+  access is a lane-vectorized one-hot select (per-row dynamic gathers/shifts
+  serialize on TPU). This is what lets fuzz histories run far past ``log_cap``
   (SURVEY.md §5: "long histories → fixed-size buffers + on-device compaction").
 """
 

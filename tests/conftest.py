@@ -3,22 +3,43 @@
 Must run before the first `import jax` in the process (pytest imports conftest
 first). Bench (`bench.py`) and the graft entry are unaffected — they run outside
 pytest and see the real TPU.
+
+Escape hatch: set MADRAFT_TPU_TESTS=1 to skip the CPU override and run the
+suite against whatever platform the environment provides (e.g. a real TPU).
+The container's interpreter-startup hook (sitecustomize) force-registers the
+TPU tunnel as "axon,cpu" regardless of JAX_PLATFORMS — that is why the
+override re-asserts the jax config after import instead of relying on the
+env var alone.
 """
 
 import os
 
-# Hard assignment, not setdefault: the driver environment presets
-# JAX_PLATFORMS (e.g. the TPU tunnel), and tests must still run on the
-# virtual CPU mesh — single-core TPU can't exercise the 8-way sharding path.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+_ON_TPU = os.environ.get("MADRAFT_TPU_TESTS") == "1"
 
-# The environment's interpreter-startup hook (sitecustomize) registers the
-# TPU-tunnel plugin and force-updates jax's platform config to "axon,cpu",
-# defeating the env var above. Re-assert CPU after import — backends are not
-# initialized yet at conftest time, so this sticks.
+if not _ON_TPU:
+    # Hard assignment, not setdefault: the driver environment presets
+    # JAX_PLATFORMS (e.g. the TPU tunnel), and tests must still run on the
+    # virtual CPU mesh — single-core TPU can't exercise the 8-way sharding path.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    # Backends are not initialized yet at conftest time, so this sticks.
+    jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the suite is compile-dominated (many distinct
+# (config, shape) step programs); with the cache warm a full run saves minutes
+# of compile. Explicit config — the cache directory merely existing is not
+# enough (round-1 mistake).
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -28,7 +28,7 @@ BASE = SimConfig(
     max_dead=2,
     p_repartition=0.02,
     p_heal=0.05,
-    log_cap=48,
+    log_cap=32,
 )
 KV = KvConfig()
 
