@@ -25,7 +25,7 @@ class SimConfig:
     ae_max: int = 4          # max entries carried per AppendEntries message
 
     def __post_init__(self):
-        if self.log_cap & (self.log_cap - 1):
+        if self.log_cap <= 0 or self.log_cap & (self.log_cap - 1):
             raise ValueError(f"log_cap must be a power of two, got {self.log_cap}")
 
     # Log compaction (the Lab 2D snapshot path, raft.rs:149-168): a node

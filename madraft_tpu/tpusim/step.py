@@ -164,9 +164,14 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     # snapshot at delivery; a dead sender = a lost message (state.py
     # rationale). The message's LEADER term deposes stale leaders exactly
     # like AE/RV traffic, and only the current term's leader may install.
+    # Every delivery (here and below) re-checks the link: simcore draws
+    # loss/latency at send but re-validates link_up at delivery
+    # (simcore.h call_timeout), so a message in flight across a partition
+    # that formed after the send is dropped on both backends — required for
+    # the differential replay bridge to be exact.
     k_snreset = jax.random.fold_in(key, _S_SNRESET)
     for src in range(n):
-        arr = (s.sn_req_t[:, src] == t) & alive & alive[src]
+        arr = (s.sn_req_t[:, src] == t) & alive & alive[src] & adj[:, src]
         delivered += jnp.sum(arr, dtype=I32)
         mterm = s.sn_req_term[:, src]
         higher = arr & (mterm > term)
@@ -206,7 +211,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     # ----------------------------------------------------- deliver: RV requests
     k_grant = jax.random.fold_in(key, _S_GRANT)
     for src in range(n):
-        arr = (s.rv_req_t[:, src] == t) & alive
+        arr = (s.rv_req_t[:, src] == t) & alive & adj[:, src]
         delivered += jnp.sum(arr, dtype=I32)
         mterm = s.rv_req_term[:, src]
         higher = arr & (mterm > term)
@@ -236,7 +241,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     k_aereset = jax.random.fold_in(key, _S_AERESET)
     lane = jnp.arange(cap, dtype=I32)[None, :]
     for src in range(n):
-        arr = (s.ae_req_t[:, src] == t) & alive
+        arr = (s.ae_req_t[:, src] == t) & alive & adj[:, src]
         delivered += jnp.sum(arr, dtype=I32)
         mterm = s.ae_req_term[:, src]
         higher = arr & (mterm > term)
@@ -316,7 +321,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
 
     # ---------------------------------------------------- deliver: RV responses
     for src in range(n):
-        arr = (rv_rsp_t[:, src] == t) & alive
+        arr = (rv_rsp_t[:, src] == t) & alive & adj[:, src]
         delivered += jnp.sum(arr, dtype=I32)
         mterm = rv_rsp_term[:, src]
         higher = arr & (mterm > term)
@@ -329,7 +334,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
 
     # ---------------------------------------------------- deliver: AE responses
     for src in range(n):
-        arr = (ae_rsp_t[:, src] == t) & alive
+        arr = (ae_rsp_t[:, src] == t) & alive & adj[:, src]
         delivered += jnp.sum(arr, dtype=I32)
         mterm = ae_rsp_term[:, src]
         higher = arr & (mterm > term)
@@ -416,7 +421,14 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         snap_term[None, :],
     )
     delay, lost = _net_draws(cfg, jax.random.fold_in(key, _S_HB), (n, n))
-    send_ae = fire_hb[None, :] & ~eye & adj.T & ~lost & ~need_snap
+    # Eager replication: a leader with unsent entries for a peer fires an AE
+    # at once — the reference replicates on start() immediately
+    # (raft.rs:266-293 fan-out); the heartbeat cadence governs only the idle
+    # case (and so the idle RPC budget, count_2b). Without this, replication
+    # throughput caps at ae_max/heartbeat_ticks and a hot leader's window
+    # outruns its followers.
+    pending = lead[None, :] & (next_idx.T <= log_len[None, :])  # [dst, src]
+    send_ae = (fire_hb[None, :] | pending) & ~eye & adj.T & ~lost & ~need_snap
     ae_req_t = jnp.where(send_ae, t + delay, ae_req_t)
     ae_req_term = jnp.where(send_ae, term[None, :], s.ae_req_term)
     ae_req_prev = jnp.where(send_ae, prev_m, s.ae_req_prev)

@@ -57,6 +57,32 @@ def test_deterministic_replay():
     assert (r1.msg_count != r3.msg_count).any()
 
 
+def test_election_and_idle_rpc_budgets():
+    """count_2b analogue (tests.rs:389-479): electing a leader must cost a
+    bounded number of messages, and an idle cluster must stay on the
+    heartbeat cadence — asserted per cluster over the whole batch. The
+    reference budgets are <=30 RPCs to elect (60 message deliveries) and
+    <=3x20 RPCs/s idle; the tick-quantized equivalent is 2(n-1) deliveries
+    per heartbeat period once a leader exists."""
+    cfg = RELIABLE
+    n_ticks = 96
+    rep = fuzz(cfg, seed=13, n_clusters=256, n_ticks=n_ticks)
+    assert rep.n_violating == 0
+    ftl = rep.first_leader_tick
+    assert (ftl >= 0).all()
+    # liveness budget: a couple of timeout rounds on a reliable net
+    assert (ftl <= 3 * cfg.election_timeout_max).all(), (
+        f"slowest election at tick {ftl.max()}"
+    )
+    # message budget: election (<=60 deliveries, the reference's 30-RPC cap)
+    # + idle heartbeats (AE + response per peer per period)
+    idle_periods = (n_ticks - ftl) // cfg.heartbeat_ticks + 1
+    budget = 60 + idle_periods * 2 * (cfg.n_nodes - 1)
+    assert (rep.msg_count <= budget).all(), (
+        f"worst overshoot {(rep.msg_count - budget).max()} deliveries"
+    )
+
+
 def test_oracle_catches_broken_quorum():
     # Validate the election-safety oracle by breaking the algorithm: a 2-vote
     # "majority" on 5 nodes lets two leaders share a term under partitions.

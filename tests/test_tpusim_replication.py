@@ -27,7 +27,7 @@ def test_basic_agree():
 def test_agreement_under_loss():
     # unreliable_agree_2c: 10% drop + jitter; safety holds, progress continues.
     cfg = SimConfig(n_nodes=5, p_client_cmd=0.2, loss_prob=0.1)
-    rep = fuzz(cfg, seed=21, n_clusters=64, n_ticks=500)
+    rep = fuzz(cfg, seed=21, n_clusters=48, n_ticks=384)
     assert rep.n_violating == 0
     assert (rep.committed >= 3).all()
 
@@ -39,7 +39,7 @@ def test_figure8_crash_storm():
         n_nodes=5, p_client_cmd=0.2, p_crash=0.02, p_restart=0.2, max_dead=2,
         loss_prob=0.05,
     )
-    rep = fuzz(cfg, seed=31, n_clusters=128, n_ticks=600)
+    rep = fuzz(cfg, seed=31, n_clusters=64, n_ticks=512)
     assert rep.n_violating == 0, (
         f"violations {rep.violations[rep.violating_clusters()]} at "
         f"ticks {rep.first_violation_tick[rep.violating_clusters()]}"
@@ -54,5 +54,5 @@ def test_churn_partitions_crashes():
         n_nodes=5, p_client_cmd=0.2, p_crash=0.01, p_restart=0.2, max_dead=2,
         p_repartition=0.02, p_heal=0.05, loss_prob=0.1,
     )
-    rep = fuzz(cfg, seed=41, n_clusters=128, n_ticks=800)
+    rep = fuzz(cfg, seed=41, n_clusters=64, n_ticks=512)
     assert rep.n_violating == 0
