@@ -1,8 +1,25 @@
 #include "raft.h"
 
 #include <algorithm>
+#include <cstdlib>
 
 namespace raftcore {
+
+// Deliberate-bug knob for the TPU<->simcore differential bridge: forcing the
+// quorum size below a real majority (e.g. 2 on a 5-node cluster) lets two
+// candidates win the same term, which the safety oracles must catch. Mirrors
+// the TPU backend's SimConfig.majority_override (madraft_tpu/tpusim/config.py)
+// so a violation class found by the batched fuzzer replays here.
+static size_t quorum(size_t n_peers) {
+  static int override_v = [] {
+    const char* e = std::getenv("MADTPU_MAJORITY_OVERRIDE");
+    return e ? std::atoi(e) : 0;
+  }();
+  // clamp: an override above the cluster size would wrap the
+  // peers_.size() - quorum() index in advance_commit
+  return override_v > 0 ? std::min((size_t)override_v, n_peers)
+                        : n_peers / 2 + 1;
+}
 
 // ------------------------------------------------------------------- boot
 
@@ -232,7 +249,7 @@ Task<void> Raft::vote_task(std::shared_ptr<Raft> self, Addr peer,
   }
   if (self->role_ == Role::Candidate && self->term_ == term && r->granted) {
     self->votes_++;
-    if (self->votes_ >= self->peers_.size() / 2 + 1) self->become_leader();
+    if (self->votes_ >= quorum(self->peers_.size())) self->become_leader();
   }
 }
 
@@ -347,7 +364,7 @@ void Raft::advance_commit() {
   std::vector<uint64_t> m = match_idx_;
   m[me_] = last_index();
   std::sort(m.begin(), m.end());
-  uint64_t majority_match = m[peers_.size() - (peers_.size() / 2 + 1)];
+  uint64_t majority_match = m[peers_.size() - quorum(peers_.size())];
   // only commit entries from the current term (Raft §5.4.2, Figure 8)
   if (majority_match > commit_ && majority_match > snap_last_index_ &&
       term_at(majority_match) == term_) {

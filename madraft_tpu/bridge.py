@@ -1,0 +1,184 @@
+"""TPU <-> simcore differential bridge: schedule export + C++ replay.
+
+The batched fuzzer reports a violating cluster as ``(seed, cluster_id)``
+(kv.py / engine.py). This module closes the loop that the reference closes
+with seed replay (/root/reference/README.md:42-55): re-run that ONE cluster
+on host, record its fault schedule — the per-tick ``alive`` bitmask and
+``adj`` adjacency matrix, i.e. exactly the crash/restart/partition decisions
+the per-cluster PRNG made — and hand the schedule to the C++ raft-core
+running on simcore (``cpp/tools/replay_main.cpp``). Schedules, not PRNG
+streams, are the interchange format (SURVEY.md §7 "determinism across
+backends"): the two backends draw from different generators, so equivalence
+is class-level — the C++ online checkers must observe the same violation
+CLASS the TPU oracles flagged.
+
+Violation-class mapping (TPU bitmask -> C++ report fields):
+  VIOLATION_DUAL_LEADER   -> dual_leader
+  VIOLATION_LOG_MATCHING  -> commit_mismatch | apply_disorder
+  VIOLATION_COMMIT_SHADOW -> commit_mismatch | apply_disorder
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import pathlib
+import subprocess
+import tempfile
+from typing import Optional
+
+import jax
+import numpy as np
+
+from madraft_tpu.tpusim.config import (
+    SimConfig,
+    VIOLATION_COMMIT_SHADOW,
+    VIOLATION_DUAL_LEADER,
+    VIOLATION_LOG_MATCHING,
+)
+from madraft_tpu.tpusim.state import init_cluster
+from madraft_tpu.tpusim.step import step_cluster
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+DEFAULT_BINARY = _REPO / "build" / "madtpu_replay"
+
+
+@dataclasses.dataclass
+class Schedule:
+    """One cluster's fault schedule plus the meta the C++ replayer needs."""
+
+    n_nodes: int
+    ms_per_tick: int
+    n_ticks: int
+    majority_override: int            # 0 = correct quorum
+    seed: int                         # simcore PRNG seed for the replay
+    # (tick, alive_bitmask) and (tick, adj row bitmasks) change events
+    alive_events: list[tuple[int, int]] = dataclasses.field(default_factory=list)
+    adj_events: list[tuple[int, list[int]]] = dataclasses.field(default_factory=list)
+    violations: int = 0               # TPU violation bitmask for this cluster
+    first_violation_tick: int = -1
+
+    def dumps(self) -> str:
+        lines = [
+            "# madtpu differential-replay schedule (bridge.py)",
+            f"nodes {self.n_nodes}",
+            f"ms_per_tick {self.ms_per_tick}",
+            f"ticks {self.n_ticks}",
+            f"majority_override {self.majority_override}",
+            f"seed {self.seed}",
+        ]
+        events = [(t, "alive", f"{m:x}") for t, m in self.alive_events] + [
+            (t, "adj", " ".join(f"{r:x}" for r in rows))
+            for t, rows in self.adj_events
+        ]
+        for t, kind, payload in sorted(events, key=lambda e: e[0]):
+            lines.append(f"ev {t} {kind} {payload}")
+        return "\n".join(lines) + "\n"
+
+
+def _bitmask(bits: np.ndarray) -> int:
+    return int(sum(1 << i for i, b in enumerate(bits) if b))
+
+
+def extract_schedule(
+    cfg: SimConfig,
+    seed: int,
+    cluster_id: int,
+    n_ticks: int,
+    step_fn=None,
+    init_fn=None,
+) -> Schedule:
+    """Re-run ONE cluster tick by tick and record its fault schedule.
+
+    ``step_fn``/``init_fn`` default to the raw raft step; service-layer
+    fuzzers (kv.py) can pass their own wrappers as long as the returned state
+    exposes ``.alive``/``.adj``/``.violations`` under a ``raft`` attribute or
+    directly. Exact per-cluster replay is cheap: one un-batched jit + n_ticks
+    dispatches.
+    """
+    step_fn = step_fn or functools.partial(step_cluster, cfg)
+    init_fn = init_fn or functools.partial(init_cluster, cfg)
+    ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
+
+    def raft_of(state):
+        return state.raft if hasattr(state, "raft") else state
+
+    # One compiled scan records the whole (alive, adj) timeline on device —
+    # [T, n] + [T, n, n] bools are tiny; per-tick host dispatch is not.
+    @jax.jit
+    def run(key):
+        def body(carry, _):
+            nxt = step_fn(carry, key)
+            r = raft_of(nxt)
+            return nxt, (r.alive, r.adj)
+
+        final, (alives, adjs) = jax.lax.scan(
+            body, init_fn(key), None, length=n_ticks
+        )
+        return final, alives, adjs
+
+    final, alives, adjs = jax.block_until_ready(run(ckey))
+    alives, adjs = np.asarray(alives), np.asarray(adjs)
+
+    sched = Schedule(
+        n_nodes=cfg.n_nodes,
+        ms_per_tick=cfg.ms_per_tick,
+        n_ticks=n_ticks,
+        majority_override=cfg.majority_override or 0,
+        seed=seed,
+    )
+    prev_alive = _bitmask(np.ones(cfg.n_nodes, bool))
+    prev_adj = [_bitmask(np.ones(cfg.n_nodes, bool))] * cfg.n_nodes
+    for t in range(1, n_ticks + 1):
+        alive = _bitmask(alives[t - 1])
+        adj = [_bitmask(row) for row in adjs[t - 1]]
+        if alive != prev_alive:
+            sched.alive_events.append((t, alive))
+            prev_alive = alive
+        if adj != prev_adj:
+            sched.adj_events.append((t, adj))
+            prev_adj = adj
+    r = raft_of(final)
+    sched.violations = int(r.violations)
+    sched.first_violation_tick = int(r.first_violation_tick)
+    return sched
+
+
+def replay_on_simcore(
+    schedule: Schedule,
+    binary: Optional[pathlib.Path] = None,
+    workdir: Optional[pathlib.Path] = None,
+) -> dict:
+    """Run the C++ replayer on a schedule; returns its JSON report."""
+    binary = pathlib.Path(binary or DEFAULT_BINARY)
+    # unique file per replay: concurrent replays must not clobber each other
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".txt", prefix="madtpu_replay_",
+        dir=str(workdir) if workdir else None, delete=False,
+    ) as f:
+        f.write(schedule.dumps())
+        path = f.name
+    try:
+        proc = subprocess.run(
+            [str(binary), path], capture_output=True, text=True, timeout=300
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"replay failed rc={proc.returncode}: {proc.stderr[-2000:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    finally:
+        os.unlink(path)
+
+
+def classes_match(tpu_violations: int, cpp_report: dict) -> bool:
+    """Did the C++ replay observe (at least) one of the TPU's violation classes?"""
+    if tpu_violations & VIOLATION_DUAL_LEADER and cpp_report["dual_leader"]:
+        return True
+    if tpu_violations & (VIOLATION_LOG_MATCHING | VIOLATION_COMMIT_SHADOW) and (
+        cpp_report["commit_mismatch"] or cpp_report["apply_disorder"]
+    ):
+        return True
+    return False

@@ -1,0 +1,93 @@
+"""TPU <-> simcore differential bridge: a TPU-found violation must replay on
+the C++ backend and trip the same violation class there.
+
+This is SURVEY.md §7 architecture item 4 ("determinism across backends") and
+the reference's seed-replay contract (/root/reference/README.md:42-55),
+expressed across backends: the interchange is the FAULT SCHEDULE (alive/adj
+timelines), not PRNG streams, because the two backends draw from different
+generators. Equivalence is therefore class-level, and validated with a
+deliberately broken quorum (majority_override=2) that both backends support.
+"""
+
+import pathlib
+import subprocess
+
+import numpy as np
+import pytest
+
+from madraft_tpu import bridge
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.engine import fuzz
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+BUILD = ROOT / "build"
+
+
+def _ensure_replay_binary() -> pathlib.Path:
+    binary = BUILD / "madtpu_replay"
+    srcs = list((ROOT / "cpp").rglob("*.cpp")) + list((ROOT / "cpp").rglob("*.h"))
+    newest = max(p.stat().st_mtime for p in srcs)
+    if not binary.exists() or binary.stat().st_mtime < newest:
+        for cmd in (
+            ["cmake", "-S", str(ROOT / "cpp"), "-B", str(BUILD), "-G", "Ninja"],
+            ["ninja", "-C", str(BUILD), "madtpu_replay"],
+        ):
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:  # surface the compiler diagnostics
+                pytest.fail(
+                    f"{' '.join(cmd)} failed:\n{proc.stdout[-2000:]}\n"
+                    f"{proc.stderr[-4000:]}"
+                )
+    return binary
+
+
+BUGGY = SimConfig(
+    n_nodes=5,
+    majority_override=2,     # deliberate bug: quorum of 2 on 5 nodes
+    loss_prob=0.1,
+    p_repartition=0.05,
+    p_heal=0.05,
+    p_client_cmd=0.3,
+)
+
+
+def test_bridge_replays_violation_class():
+    """Find a violating cluster on the batched backend, export its fault
+    schedule, replay on simcore, and require the same violation class."""
+    binary = _ensure_replay_binary()
+    n_ticks = 384
+    rep = fuzz(BUGGY, seed=7, n_clusters=64, n_ticks=n_ticks)
+    bad = rep.violating_clusters()
+    assert bad.size > 0, "quorum=2 must produce violations on the TPU backend"
+
+    # Pick the violating cluster whose classes include a commit/log class if
+    # one exists (richest cross-backend signal); else the first.
+    viol = rep.violations[bad]
+    prefer = bad[(viol & 6) != 0]  # LOG_MATCHING | COMMIT_SHADOW
+    cluster = int(prefer[0] if prefer.size else bad[0])
+
+    sched = bridge.extract_schedule(BUGGY, seed=7, cluster_id=cluster,
+                                    n_ticks=n_ticks)
+    assert sched.violations == rep.violations[cluster], (
+        "single-cluster replay must reproduce the batched run exactly "
+        "(same PRNG stream)"
+    )
+    cpp = bridge.replay_on_simcore(sched, binary=binary)
+    assert bridge.classes_match(sched.violations, cpp), (
+        f"C++ replay saw no matching violation class: tpu={sched.violations:#x} "
+        f"cpp={cpp}"
+    )
+
+
+def test_bridge_clean_on_correct_quorum():
+    """Sanity: with the correct quorum the same schedule shape yields zero
+    violations on both backends."""
+    binary = _ensure_replay_binary()
+    cfg = BUGGY.replace(majority_override=None)
+    n_ticks = 256
+    rep = fuzz(cfg, seed=11, n_clusters=32, n_ticks=n_ticks)
+    assert rep.n_violating == 0
+    sched = bridge.extract_schedule(cfg, seed=11, cluster_id=3, n_ticks=n_ticks)
+    cpp = bridge.replay_on_simcore(sched, binary=binary)
+    assert not cpp["dual_leader"] and not cpp["commit_mismatch"], cpp
+    assert cpp["max_applied"] > 0, "replay must make progress"
