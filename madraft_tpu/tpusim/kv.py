@@ -52,11 +52,15 @@ from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 # Additional violation bits (extending config.VIOLATION_*).
 VIOLATION_EXACTLY_ONCE = 8   # duplicate or out-of-order apply of a client op
 VIOLATION_KV_DIVERGE = 16    # equal apply cursors, different KV state
+VIOLATION_STALE_READ = 32    # a Get observed a state outside its invoke..return
+#                              linearization window (reads linearizability)
 
 _SEQ_LIM = 1 << 15  # packing limit: seq fits 15 bits
+_APPEND, _GET = 0, 1  # op kinds (the reference's Op::{Append,Get}, msg.rs:3-8)
 
 # PRNG site ids, disjoint from step.py's 0..7.
 _S_CLERK_START, _S_CLERK_TARGET, _S_CLERK_RETRY, _S_CLERK_KEY = 8, 9, 10, 11
+_S_CLERK_KIND = 14
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,11 +70,16 @@ class KvConfig:
     n_clients: int = 4
     n_keys: int = 4
     p_op: float = 0.3           # idle clerk starts a fresh op
+    p_get: float = 0.3          # a fresh op is a Get (else an Append)
     p_retry: float = 0.5        # pending clerk re-submits this tick
     apply_max: int = 4          # apply-machine entries per node per tick
     # Oracle-validation bug modes (None/False = correct service).
     bug_skip_dedup: bool = False        # apply duplicates blindly
     bug_apply_uncommitted: bool = False  # apply past the commit index
+    bug_stale_read: bool = False  # serve Gets from the contacted node's local
+    #                               (possibly lagging) state at submit time —
+    #                               the classic read-from-follower bug the
+    #                               linearizability oracle must catch
 
     def replace(self, **kw) -> "KvConfig":
         return dataclasses.replace(self, **kw)
@@ -84,7 +93,24 @@ class KvState(NamedTuple):
     clerk_seq: jax.Array     # i32 last started seq (0 = none yet)
     clerk_out: jax.Array     # bool: op clerk_seq is still uncommitted
     clerk_key: jax.Array     # i32 key of the outstanding op
+    clerk_kind: jax.Array    # i32 op kind: _APPEND or _GET
     clerk_acked: jax.Array   # i32 highest committed (acked) seq
+    # --- reads-linearizability oracle state ---
+    # Appends are the only mutations and the log totally orders them, so key
+    # k's state IS its committed-append count; a Get is linearizable iff its
+    # observed count lies in [truth at invoke, truth at return]. This interval
+    # check is exact for this datatype: for non-overlapping reads r1 < r2,
+    # obs(r2) >= truth(invoke r2) >= truth(return r1) >= obs(r1), i.e.
+    # monotonicity follows. It is the batched, closed-form analogue of the
+    # Wing-Gong checker the C++ backend runs (cpp/kvraft/linearize.h; the
+    # reference leaves those tests commented out, kvraft/tests.rs:386-390).
+    truth_count: jax.Array   # i32 [NK] committed appends per key (shadow-derived,
+    #                          DEDUPED: clerk retries commit duplicate entries;
+    #                          state counts each op once, so truth must too)
+    truth_max_seq: jax.Array  # i32 [NC] highest seq seen in the shadow per client
+    clerk_get_lo: jax.Array  # i32 [NC] truth_count[key] captured at invoke
+    clerk_get_obs: jax.Array  # i32 [NC] observed count; -1 = no reply yet
+    gets_done: jax.Array     # i32 [NC] completed Gets (workload metric)
     # --- per-node apply machines. The live set is volatile (crash resets to
     # the snapshot); the snap_* set is the persisted service snapshot at the
     # node's log base (the reference's "snapshot" file: dup table + state,
@@ -101,15 +127,17 @@ class KvState(NamedTuple):
     snap_key_count: jax.Array    # i32 [N, NK] (persistent)
 
 
-def _pack(cfg: KvConfig, client, seq, key):
-    return ((client * _SEQ_LIM + seq) * cfg.n_keys + key) + 1
+def _pack(cfg: KvConfig, client, seq, key, kind):
+    return (((client * _SEQ_LIM + seq) * cfg.n_keys + key) * 2 + kind) + 1
 
 
 def _unpack(cfg: KvConfig, val):
     v = val - 1
+    kind = v % 2
+    v = v // 2
     key = v % cfg.n_keys
     cs = v // cfg.n_keys
-    return cs // _SEQ_LIM, cs % _SEQ_LIM, key  # client, seq, key
+    return cs // _SEQ_LIM, cs % _SEQ_LIM, key, kind  # client, seq, key, kind
 
 
 def init_kv_cluster(cfg: SimConfig, kcfg: KvConfig, key: jax.Array) -> KvState:
@@ -119,7 +147,13 @@ def init_kv_cluster(cfg: SimConfig, kcfg: KvConfig, key: jax.Array) -> KvState:
         clerk_seq=jnp.zeros((nc,), I32),
         clerk_out=jnp.zeros((nc,), jnp.bool_),
         clerk_key=jnp.zeros((nc,), I32),
+        clerk_kind=jnp.zeros((nc,), I32),
         clerk_acked=jnp.zeros((nc,), I32),
+        truth_count=jnp.zeros((nk,), I32),
+        truth_max_seq=jnp.zeros((nc,), I32),
+        clerk_get_lo=jnp.zeros((nc,), I32),
+        clerk_get_obs=jnp.full((nc,), -1, I32),
+        gets_done=jnp.zeros((nc,), I32),
         applied=jnp.zeros((n,), I32),
         last_seq=jnp.zeros((n, nc), I32),
         apply_count=jnp.zeros((n, nc), I32),
@@ -148,6 +182,41 @@ def kv_step(
     s = step_cluster(cfg, pre, cluster_key)
     t = s.tick
     key = jax.random.fold_in(cluster_key, t)
+    nk = kcfg.n_keys
+
+    # Committed truth per key (reads-linearizability ground truth): count the
+    # appends newly recorded in the commit shadow this tick, DEDUPED the same
+    # way the apply machines dedup — clerk retries put the same op at several
+    # log positions, but the state applies it once. An entry is first-occurrence
+    # iff its seq exceeds the client's max seq already seen (clerks serialize
+    # seqs, so cross-tick duplicates always carry a stale seq) and no earlier
+    # new lane this tick holds the same op. The shadow is the total order; an
+    # entry that slides past the window in a single tick escapes the count,
+    # matching the shadow oracle's own window caveat.
+    sh_abs_now = _lane_abs(s.shadow_base, cap)  # [cap]
+    sh_client, sh_seq, sh_key, sh_kind = _unpack(kcfg, s.shadow_val)
+    sh_client = jnp.clip(sh_client, 0, nc - 1)
+    sh_new = (sh_abs_now > pre.shadow_len) & (sh_abs_now <= s.shadow_len)
+    cl_oh_sh = sh_client[:, None] == jnp.arange(nc, dtype=I32)[None, :]  # [cap, nc]
+    prev_max_at = jnp.sum(
+        jnp.where(cl_oh_sh, ks.truth_max_seq[None, :], 0), axis=1
+    )  # [cap]: truth_max_seq[client of lane]
+    dup_earlier = jnp.any(
+        sh_new[None, :]
+        & (sh_abs_now[None, :] < sh_abs_now[:, None])
+        & (s.shadow_val[None, :] == s.shadow_val[:, None]),
+        axis=1,
+    )  # [cap]: an earlier new lane holds the same op
+    sh_first = sh_new & (sh_seq > prev_max_at) & ~dup_earlier
+    truth_count = ks.truth_count + jnp.sum(
+        (sh_first & (sh_kind == _APPEND))[None, :]
+        & (sh_key[None, :] == jnp.arange(nk, dtype=I32)[:, None]),
+        axis=1, dtype=I32,
+    )
+    truth_max_seq = jnp.maximum(
+        ks.truth_max_seq,
+        jnp.max(jnp.where(sh_new[:, None] & cl_oh_sh, sh_seq[:, None], 0), axis=0),
+    )
 
     applied = ks.applied
     last_seq, apply_count = ks.last_seq, ks.apply_count
@@ -205,32 +274,57 @@ def kv_step(
     lane = jnp.arange(cap, dtype=I32)[None, :]
     cl_lane = jnp.arange(nc, dtype=I32)[None, :]
     k_lane = jnp.arange(kcfg.n_keys, dtype=I32)[None, :]
+    clerk_get_obs = ks.clerk_get_obs
+    cl_ids = jnp.arange(nc, dtype=I32)
     for _ in range(kcfg.apply_max):
         can = s.alive & (applied < limit)
         pos = _slot(applied + 1, cap)  # canonical ring lane of index applied+1
         val = jnp.sum(jnp.where(lane == pos[:, None], s.log_val, 0), axis=-1)
-        client, seq, k = _unpack(kcfg, val)
+        client, seq, k, kind = _unpack(kcfg, val)
         client = jnp.clip(client, 0, nc - 1)
         cl_oh = cl_lane == client[:, None]            # [n, nc]
         prev = jnp.sum(jnp.where(cl_oh, last_seq, 0), axis=-1)
         dup = seq <= prev
         # order oracle: a first-time seq must be exactly prev+1 (the clerk
-        # starts s+1 only after s committed, so committed order is gap-free)
-        viol |= jnp.where(jnp.any(can & ~dup & (seq > prev + 1)),
-                          VIOLATION_EXACTLY_ONCE, 0)
+        # starts s+1 only after s committed, so committed order is gap-free).
+        # bug_stale_read serves Gets outside the log, so gaps are legitimate
+        # there and the gap-based checks stand down.
+        if not kcfg.bug_stale_read:
+            viol |= jnp.where(jnp.any(can & ~dup & (seq > prev + 1)),
+                              VIOLATION_EXACTLY_ONCE, 0)
         do = can if kcfg.bug_skip_dedup else (can & ~dup)
-        k_oh = (k_lane == k[:, None]) & do[:, None]   # [n, nk]
+        # Gets read; only Appends mutate the key state.
+        mut = do & (kind == _APPEND)
+        k_oh = (k_lane == k[:, None]) & mut[:, None]  # [n, nk]
         key_hash = jnp.where(k_oh, key_hash * 1000003 + val[:, None], key_hash)
         key_count = jnp.where(k_oh, key_count + 1, key_count)
         apply_count = jnp.where(cl_oh & do[:, None], apply_count + 1, apply_count)
         last_seq = jnp.where(
             cl_oh & can[:, None], jnp.maximum(prev, seq)[:, None], last_seq
         )
+        # Get observation: the value a Get returns is the key's applied-append
+        # count at its log position — a pure function of the log prefix, so
+        # the first node to apply it yields the canonical reply (agreement
+        # between apply machines is checked separately by KV_DIVERGE).
+        obs_node = jnp.sum(
+            jnp.where(k_lane == k[:, None], key_count, 0), axis=-1
+        )  # [n]
+        get_apply = do & (kind == _GET)
+        m = (
+            get_apply[None, :]
+            & (client[None, :] == cl_ids[:, None])
+            & (seq[None, :] == ks.clerk_seq[:, None])
+        )  # [nc, n]
+        cand = jnp.max(jnp.where(m, obs_node[None, :], -1), axis=1)
+        clerk_get_obs = jnp.where(
+            (clerk_get_obs < 0) & (cand >= 0), cand, clerk_get_obs
+        )
         applied = jnp.where(can, applied + 1, applied)
 
     # exactly-once: ops applied per client == highest seq applied
-    viol |= jnp.where(jnp.any(s.alive[:, None] & (apply_count != last_seq)),
-                      VIOLATION_EXACTLY_ONCE, 0)
+    if not kcfg.bug_stale_read:
+        viol |= jnp.where(jnp.any(s.alive[:, None] & (apply_count != last_seq)),
+                          VIOLATION_EXACTLY_ONCE, 0)
 
     # state-machine agreement: equal cursors => identical applied state
     same_cursor = (
@@ -245,23 +339,37 @@ def kv_step(
     )
     viol |= jnp.where(jnp.any(same_cursor & ~hash_eq), VIOLATION_KV_DIVERGE, 0)
 
-    violations = s.violations | viol
-    first_violation_tick = jnp.where(
-        (s.first_violation_tick < 0) & (viol != 0), t, s.first_violation_tick
-    )
-
     # ------------------------------------------------------------------ clerks
     # ack: an outstanding op is acked once it appears in the committed shadow
-    # log (ground truth of commits — the clerk's Ok reply). The shadow is a
-    # window; a clerk polls every tick, far faster than the window slides.
-    want = _pack(kcfg, jnp.arange(nc, dtype=I32), ks.clerk_seq, ks.clerk_key)
+    # log (ground truth of commits — the clerk's Ok reply); a Get additionally
+    # needs its observation (recorded at first apply). The shadow is a window;
+    # a clerk polls every tick, far faster than the window slides.
+    key_lane = jnp.arange(nk, dtype=I32)[None, :]
+    truth_at = jnp.sum(
+        jnp.where(key_lane == ks.clerk_key[:, None], truth_count[None, :], 0),
+        axis=1,
+    )  # [nc]: committed-append truth for each clerk's key, as of now
+    want = _pack(kcfg, cl_ids, ks.clerk_seq, ks.clerk_key, ks.clerk_kind)
     sh_live = _lane_abs(s.shadow_base, cap) <= s.shadow_len  # canonical ring
     in_shadow = jnp.any(
         (s.shadow_val[None, :] == want[:, None]) & sh_live[None, :], axis=1
     )
-    newly_acked = ks.clerk_out & in_shadow
+    is_get = ks.clerk_kind == _GET
+    newly_acked = ks.clerk_out & in_shadow & (~is_get | (clerk_get_obs >= 0))
+    # Reads linearizability: the observed count must lie in the op's
+    # [invoke, return] truth window (exact for append-count registers; see
+    # the KvState docstring).
+    done_get = newly_acked & is_get
+    viol |= jnp.where(
+        jnp.any(
+            done_get
+            & ((clerk_get_obs < ks.clerk_get_lo) | (clerk_get_obs > truth_at))
+        ),
+        VIOLATION_STALE_READ, 0,
+    )
     clerk_acked = jnp.where(newly_acked, ks.clerk_seq, ks.clerk_acked)
     clerk_out = ks.clerk_out & ~newly_acked
+    gets_done = ks.gets_done + done_get.astype(I32)
 
     # start fresh ops / retry pending ones
     kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 4)
@@ -276,15 +384,70 @@ def kv_step(
         jax.random.randint(kk[1], (nc,), 0, kcfg.n_keys, dtype=I32),
         ks.clerk_key,
     )
+    clerk_kind = jnp.where(
+        start,
+        jax.random.bernoulli(
+            jax.random.fold_in(key, _S_CLERK_KIND), kcfg.p_get, (nc,)
+        ).astype(I32),
+        ks.clerk_kind,
+    )
+    # a fresh Get captures its invoke-time truth; its observation resets
+    truth_at_new = jnp.sum(
+        jnp.where(key_lane == clerk_key[:, None], truth_count[None, :], 0),
+        axis=1,
+    )
+    clerk_get_lo = jnp.where(start, truth_at_new, ks.clerk_get_lo)
+    clerk_get_obs = jnp.where(start, -1, clerk_get_obs)
     clerk_out = clerk_out | start
     retry = clerk_out & (
         start | jax.random.bernoulli(kk[2], kcfg.p_retry, (nc,))
     )
     target = jax.random.randint(kk[3], (nc,), 0, n, dtype=I32)
 
+    if kcfg.bug_stale_read:
+        # Bug mode: the contacted node — leader or not — serves the Get
+        # immediately from its own (possibly lagging) applied state, skipping
+        # the log. The classic read-from-follower bug; the linearizability
+        # oracle must flag any observation below the invoke-time truth.
+        tgt_oh = me[None, :] == target[:, None]  # [nc, n]
+        local_cnt = jnp.sum(
+            jnp.where(
+                tgt_oh[:, :, None]
+                & (jnp.arange(nk, dtype=I32)[None, None, :]
+                   == clerk_key[:, None, None]),
+                key_count[None, :, :], 0,
+            ),
+            axis=(1, 2),
+        )  # [nc]: key_count[target_c, key_c]
+        served = (
+            retry
+            & (clerk_kind == _GET)
+            & jnp.any(tgt_oh & s.alive[None, :], axis=1)
+        )
+        # upper bound = truth at serve time — identical to truth_at_new above
+        # (same clerk_key, same truth_count; nothing commits in between)
+        viol |= jnp.where(
+            jnp.any(
+                served
+                & ((local_cnt < clerk_get_lo) | (local_cnt > truth_at_new))
+            ),
+            VIOLATION_STALE_READ, 0,
+        )
+        clerk_acked = jnp.where(served, clerk_seq, clerk_acked)
+        clerk_out = clerk_out & ~served
+        gets_done = gets_done + served.astype(I32)
+        retry = retry & ~served
+
+    violations = s.violations | viol
+    first_violation_tick = jnp.where(
+        (s.first_violation_tick < 0) & (viol != 0), t, s.first_violation_tick
+    )
+
     # submit: append at the targeted node iff it believes it is the leader
     # (RaftHandle::start, raft.rs:131; a stale leader accepts and the entry
-    # is later overwritten — the rejoin_2b scenario).
+    # is later overwritten — the rejoin_2b scenario). Gets ride the log too:
+    # the committed-read path (the reference commits Get ops for exactly this
+    # linearizability, kvraft/server.rs Op::Get).
     log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
     for c in range(nc):
         sel = me == target[c]                         # one-hot over nodes
@@ -295,7 +458,8 @@ def kv_step(
             & (s.role == LEADER)
             & (log_len - s.base < cap)  # window has room
         )
-        v = _pack(kcfg, jnp.asarray(c, I32), clerk_seq[c], clerk_key[c])
+        v = _pack(kcfg, jnp.asarray(c, I32), clerk_seq[c], clerk_key[c],
+                  clerk_kind[c])
         hit = ok[:, None] & (lane == _slot(log_len + 1, cap)[:, None])
         log_term = jnp.where(hit, s.term[:, None], log_term)
         log_val = jnp.where(hit, v, log_val)
@@ -315,7 +479,13 @@ def kv_step(
         clerk_seq=clerk_seq,
         clerk_out=clerk_out,
         clerk_key=clerk_key,
+        clerk_kind=clerk_kind,
         clerk_acked=clerk_acked,
+        truth_count=truth_count,
+        truth_max_seq=truth_max_seq,
+        clerk_get_lo=clerk_get_lo,
+        clerk_get_obs=clerk_get_obs,
+        gets_done=gets_done,
         applied=applied,
         last_seq=last_seq,
         apply_count=apply_count,
@@ -333,6 +503,7 @@ class KvFuzzReport(NamedTuple):
     violations: np.ndarray            # i32 bitmask per cluster
     first_violation_tick: np.ndarray  # -1 = none
     acked_ops: np.ndarray             # committed client ops per cluster
+    acked_gets: np.ndarray            # completed Gets per cluster
     committed: np.ndarray             # committed log entries per cluster
     msg_count: np.ndarray
     snap_installs: np.ndarray         # install-snapshot deliveries
@@ -384,6 +555,7 @@ def kv_report(final: KvState) -> KvFuzzReport:
         violations=np.asarray(final.raft.violations),
         first_violation_tick=np.asarray(final.raft.first_violation_tick),
         acked_ops=np.asarray(final.clerk_acked.sum(axis=-1)),
+        acked_gets=np.asarray(final.gets_done.sum(axis=-1)),
         committed=np.asarray(final.raft.shadow_len),
         msg_count=np.asarray(final.raft.msg_count),
         snap_installs=np.asarray(final.raft.snap_install_count),

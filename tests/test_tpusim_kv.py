@@ -12,6 +12,7 @@ from madraft_tpu.tpusim import SimConfig
 from madraft_tpu.tpusim.kv import (
     KvConfig,
     VIOLATION_EXACTLY_ONCE,
+    VIOLATION_STALE_READ,
     kv_fuzz,
     kv_replay_cluster,
     make_kv_fuzz_fn,
@@ -35,14 +36,15 @@ KV = KvConfig()
 
 def test_kv_fuzz_clean():
     """Fault storm over many clusters: no violations, real client progress."""
-    rep = kv_fuzz(BASE, KV, seed=7, n_clusters=192, n_ticks=384)
+    rep = kv_fuzz(BASE, KV, seed=7, n_clusters=96, n_ticks=320)
     assert rep.n_violating == 0, (
         f"violations in clusters {rep.violating_clusters()[:8]}: "
         f"{rep.violations[rep.violating_clusters()[:8]]}"
     )
-    # the workload must actually exercise the service
+    # the workload must actually exercise the service — including reads
     assert (rep.acked_ops > 0).mean() > 0.9
-    assert rep.acked_ops.sum() > 192 * 5
+    assert rep.acked_ops.sum() > 96 * 5
+    assert rep.acked_gets.sum() > 96, "Get ops must flow and complete"
 
 
 def test_kv_dedup_oracle_fires():
@@ -50,7 +52,7 @@ def test_kv_dedup_oracle_fires():
     retries create duplicate log entries, and the dup table is the only thing
     standing between them and a double Append."""
     rep = kv_fuzz(BASE, KV.replace(bug_skip_dedup=True), seed=7,
-                  n_clusters=192, n_ticks=384)
+                  n_clusters=96, n_ticks=320)
     assert rep.n_violating > 0
     assert np.all(
         (rep.violations[rep.violating_clusters()] & VIOLATION_EXACTLY_ONCE) != 0
@@ -61,14 +63,28 @@ def test_kv_uncommitted_apply_oracle_fires():
     """Applying past the commit index must trip an oracle (divergence between
     apply machines, or commit-shadow once overwritten entries commit)."""
     rep = kv_fuzz(BASE, KV.replace(bug_apply_uncommitted=True), seed=7,
-                  n_clusters=192, n_ticks=384)
+                  n_clusters=96, n_ticks=320)
     assert rep.n_violating > 0
+
+
+def test_kv_stale_read_oracle_fires():
+    """Serving Gets from the contacted node's local state without committing
+    them (the read-from-follower bug) must trip the reads-linearizability
+    oracle: a lagging node's state is below the invoke-time committed truth.
+    The reference leaves its linearizability tests commented out
+    (kvraft/tests.rs:386-390); this is their on-device analogue."""
+    rep = kv_fuzz(BASE, KV.replace(bug_stale_read=True, p_get=0.5), seed=7,
+                  n_clusters=96, n_ticks=320)
+    assert rep.n_violating > 0
+    assert np.any(
+        (rep.violations[rep.violating_clusters()] & VIOLATION_STALE_READ) != 0
+    )
 
 
 def test_kv_deterministic_and_replay():
     """Same seed => bit-identical report; single-cluster replay reproduces."""
-    r1 = kv_fuzz(BASE, KV, seed=123, n_clusters=64, n_ticks=256)
-    r2 = kv_fuzz(BASE, KV, seed=123, n_clusters=64, n_ticks=256)
+    r1 = kv_fuzz(BASE, KV, seed=123, n_clusters=48, n_ticks=256)
+    r2 = kv_fuzz(BASE, KV, seed=123, n_clusters=48, n_ticks=256)
     for a, b in zip(r1, r2):
         np.testing.assert_array_equal(a, b)
     # replay cluster 3 alone and match the batched run's observables
