@@ -29,8 +29,33 @@ from madraft_tpu.tpusim.kv import (
     make_kv_fuzz_fn,
 )
 
+from madraft_tpu.tpusim.shardkv import (
+    VIOLATION_SHARD_DIVERGE,
+    VIOLATION_SHARD_OWNERSHIP,
+    VIOLATION_SHARD_STORAGE,
+    ShardKvConfig,
+    ShardKvFuzzReport,
+    ShardKvState,
+    init_shardkv_cluster,
+    make_shardkv_fuzz_fn,
+    shardkv_fuzz,
+    shardkv_report,
+    shardkv_step,
+)
+
 __all__ = [
     "SimConfig",
+    "ShardKvConfig",
+    "ShardKvFuzzReport",
+    "ShardKvState",
+    "init_shardkv_cluster",
+    "make_shardkv_fuzz_fn",
+    "shardkv_fuzz",
+    "shardkv_report",
+    "shardkv_step",
+    "VIOLATION_SHARD_DIVERGE",
+    "VIOLATION_SHARD_OWNERSHIP",
+    "VIOLATION_SHARD_STORAGE",
     "ClusterState",
     "init_cluster",
     "step_cluster",

@@ -1,0 +1,918 @@
+"""Batched multi-group sharded-KV fuzzing on TPU (Lab 4B; the groups axis).
+
+The reference's shardkv (SURVEY.md §2 C9, /root/reference/src/shardkv/) runs
+G raft groups, a shard controller assigning N_SHARDS shards to groups, and a
+migration protocol that pulls shards between groups on reconfiguration, with
+two "challenges": delete surrendered shards (bounded storage,
+tests.rs:438-493) and keep serving unaffected shards mid-migration
+(tests.rs:499-605). This module is its TPU-native re-imagination:
+
+- Each simulated *deployment* holds G complete raft clusters — the existing
+  ``step_cluster`` vmapped over a groups axis — plus the service layer as
+  dense tensors. ``vmap`` over deployments gives the fuzz batch.
+- The shard controller is not simulated as a fourth raft cluster; it is a
+  pre-drawn **config schedule tensor** (activation tick + shard->group map per
+  config), the batched analogue of the reference's ctrler service whose
+  content the tests fully script anyway (join/leave calls). Correctness of
+  the *controller itself* is covered by the C++ backend's 4A suite.
+- Config adoption, shard install, and shard deletion all ride each group's
+  raft log as marker entries (CONFIG/INSTALL/DELETE), so crash-restart
+  recovery and duplicate suppression work exactly like client ops — the
+  reference commits config changes and migrations through raft the same way.
+  The pull payload itself (per-shard state + dup table) is modeled as riding
+  the INSTALL entry via a per-group staging buffer filled by the inter-group
+  pull response (the tensor analogue of the RPC payload).
+- Inter-group traffic (pull request / pull response / ack) uses per-
+  (dst_group, src_group, shard) mailbox tensors with the same delivery-tick +
+  loss semantics as the in-group network.
+
+Oracles (all on-device reductions, sticky violation bits):
+- A **truth walker** per group: a canonical service state machine advanced
+  along the group's committed shadow log (bounded entries/tick). It maintains
+  the per-shard phases, per-shard state and the MIGRATED dup tables exactly
+  as a correct server would. Any alive node whose apply cursor equals the
+  walker frontier must match it bit-for-bit (VIOLATION_SHARD_DIVERGE) — this
+  is what catches exactly-once-across-migration bugs: an un-migrated dup
+  table or a serve-after-freeze both diverge from the walker.
+- **Ownership exclusivity** (VIOLATION_SHARD_OWNERSHIP): no shard may be
+  walker-OWNED by two groups at once; the freeze-before-pull protocol makes
+  dual ownership impossible in a correct implementation.
+- **Storage bound** (VIOLATION_SHARD_STORAGE): at most one extra (frozen)
+  copy of a shard may exist during migration; frozen copies must disappear
+  after ack+delete — challenge 1's bound as an invariant.
+- Bug modes validate the oracles: ``bug_skip_freeze`` (a lost shard keeps
+  serving at the nodes) and ``bug_drop_dup_table`` (INSTALL resets the dup
+  table, so migrated-away retries double-apply).
+
+Entry packing (i32 log values, low 2 bits = kind):
+  APPEND  ((client*SEQ_LIM + seq)*NS + shard)*4 + 0 + 1
+  CONFIG  (cfg_idx)*4 + 1 + 1
+  INSTALL (cfg_idx*NS + shard)*4 + 2 + 1
+  DELETE  (cfg_idx*NS + shard)*4 + 3 + 1
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from madraft_tpu.tpusim.config import LEADER, SimConfig
+from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
+from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
+
+# Violation bits (extending config.VIOLATION_* and kv.VIOLATION_*).
+VIOLATION_SHARD_DIVERGE = 64     # node state != truth walker at equal cursor
+VIOLATION_SHARD_OWNERSHIP = 128  # a shard walker-OWNED by two groups at once
+VIOLATION_SHARD_STORAGE = 256    # state retained for an ABSENT shard (GC leak)
+
+_SEQ_LIM = 1 << 13
+
+# Entry kinds.
+_APPEND, _CONFIG, _INSTALL, _DELETE = 0, 1, 2, 3
+# Shard phases.
+ABSENT, OWNED, PULLING, FROZEN = 0, 1, 2, 3
+
+# PRNG site ids (disjoint from step.py 0..7 and kv.py 8..14).
+_S_GROUP = 100       # + g: per-group raft stream
+_S_POLL = 16
+_S_PULL = 17
+_S_CLERK = 18
+_S_CFGGEN = 19
+_S_NET_PULL = 20
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardKvConfig:
+    """Static knobs of the sharded-KV fuzzing layer."""
+
+    n_groups: int = 3
+    n_shards: int = 10          # the reference's N_SHARDS (shard_ctrler/mod.rs:9)
+    n_clients: int = 4
+    n_configs: int = 6          # length of the pre-drawn config schedule
+    cfg_interval: int = 60      # mean ticks between config activations
+    p_op: float = 0.4           # idle clerk starts a fresh op
+    p_retry: float = 0.5        # pending clerk re-submits this tick
+    p_cfg_learn: float = 0.3    # clerk/leader learns a newer config this tick
+    p_pull: float = 0.4         # leader (re)sends pull/ack for a pending shard
+    pull_delay_min: int = 1
+    pull_delay_max: int = 3
+    pull_loss: float = 0.1      # inter-group message loss
+    apply_max: int = 4          # apply-machine entries per node per tick
+    walk_max: int = 6           # truth-walker entries per group per tick
+    # Oracle-validation bug modes (False = correct service).
+    bug_skip_freeze: bool = False    # lost shards keep serving at the nodes
+    bug_drop_dup_table: bool = False  # INSTALL resets the migrated dup table
+
+    def replace(self, **kw) -> "ShardKvConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def _pack_append(cfg: ShardKvConfig, client, seq, shard):
+    return (((client * _SEQ_LIM + seq) * cfg.n_shards + shard) * 4 + _APPEND) + 1
+
+
+def _pack_config(cfg_idx):
+    return (cfg_idx * 4 + _CONFIG) + 1
+
+
+def _pack_install(cfg: ShardKvConfig, cfg_idx, shard):
+    return ((cfg_idx * cfg.n_shards + shard) * 4 + _INSTALL) + 1
+
+
+def _pack_delete(cfg: ShardKvConfig, cfg_idx, shard):
+    return ((cfg_idx * cfg.n_shards + shard) * 4 + _DELETE) + 1
+
+
+def _unpack(cfg: ShardKvConfig, val):
+    """-> (kind, client, seq, shard, cfg_idx); fields valid per kind."""
+    v = val - 1
+    kind = v % 4
+    payload = v // 4
+    shard = payload % cfg.n_shards
+    cs = payload // cfg.n_shards
+    client = cs // _SEQ_LIM
+    seq = cs % _SEQ_LIM
+    cfg_idx_c = payload  # CONFIG payload
+    cfg_idx_i = payload // cfg.n_shards  # INSTALL/DELETE payload
+    return kind, client, seq, shard, cfg_idx_c, cfg_idx_i
+
+
+class ShardKvState(NamedTuple):
+    """One deployment: G raft groups + service layer (vmap adds deployments)."""
+
+    rafts: ClusterState          # every leaf has leading axis [G]
+    # --- controller schedule (drawn at init, constant thereafter) ---
+    cfg_tick: jax.Array          # i32 [NCFG] activation tick of config j
+    cfg_owner: jax.Array         # i32 [NCFG, NS] owning group per shard
+    # --- per-node service state (volatile; rebuilt by log replay) ---
+    applied: jax.Array           # i32 [G, N] apply cursor (absolute)
+    node_cfg: jax.Array          # i32 [G, N] highest config applied
+    phase: jax.Array             # i32 [G, N, NS] ABSENT/OWNED/PULLING/FROZEN
+    key_hash: jax.Array          # i32 [G, N, NS]
+    key_count: jax.Array         # i32 [G, N, NS]
+    last_seq: jax.Array          # i32 [G, N, NS, NC] per-shard dup table
+    # --- persisted service snapshot at each node's log base ---
+    snap_cfg: jax.Array          # i32 [G, N]
+    snap_phase: jax.Array        # i32 [G, N, NS]
+    snap_hash: jax.Array         # i32 [G, N, NS]
+    snap_count: jax.Array        # i32 [G, N, NS]
+    snap_last_seq: jax.Array     # i32 [G, N, NS, NC]
+    # --- group-level pull staging (payload "riding" the INSTALL entry) ---
+    staged_cfg: jax.Array        # i32 [G, NS] config of staged payload (-1 none)
+    staged_hash: jax.Array       # i32 [G, NS]
+    staged_count: jax.Array      # i32 [G, NS]
+    staged_last_seq: jax.Array   # i32 [G, NS, NC]
+    # --- inter-group mailboxes [dst_g, src_g, NS] (delivery tick; 0 empty) ---
+    pull_req_t: jax.Array
+    pull_req_cfg: jax.Array
+    pull_rsp_t: jax.Array
+    pull_rsp_cfg: jax.Array
+    pull_rsp_hash: jax.Array
+    pull_rsp_count: jax.Array
+    pull_rsp_last_seq: jax.Array  # [dst, src, NS, NC]
+    ack_t: jax.Array              # dst(=old owner) <- src(=new owner)
+    ack_cfg: jax.Array
+    # --- clerks [NC] ---
+    clerk_seq: jax.Array
+    clerk_out: jax.Array          # bool
+    clerk_shard: jax.Array
+    clerk_cfg: jax.Array          # clerk's believed config index
+    clerk_acked: jax.Array
+    # --- truth walker (oracle ground truth at each group's shadow frontier) ---
+    w_frontier: jax.Array        # i32 [G] entries walked (absolute shadow index)
+    w_cfg: jax.Array             # i32 [G]
+    w_phase: jax.Array           # i32 [G, NS]
+    w_hash: jax.Array            # i32 [G, NS]
+    w_count: jax.Array           # i32 [G, NS]
+    w_last_seq: jax.Array        # i32 [G, NS, NC]
+    frz_cfg: jax.Array           # i32 [NS] walker freeze-snapshot config (-1)
+    frz_hash: jax.Array          # i32 [NS]
+    frz_count: jax.Array         # i32 [NS]
+    frz_last_seq: jax.Array      # i32 [NS, NC]
+    truth_count: jax.Array       # i32 [NS] accepted appends per shard
+    w_clerk_acked: jax.Array     # i32 [NC] walker-accepted seq per client
+    installs_done: jax.Array     # i32 scalar: INSTALL entries walked
+    deletes_done: jax.Array      # i32 scalar: DELETE entries walked
+    # --- deployment-level violations (group raft violations live in rafts) ---
+    violations: jax.Array        # i32 scalar sticky bitmask
+    first_violation_tick: jax.Array
+
+
+def _gen_schedule(cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array):
+    """Config schedule: activation ticks + owner maps. Config 0 is round-robin
+    at tick 0; each later config moves one random shard to a random group
+    (the join/leave churn of tests.rs:193-362, as data)."""
+    ncfg, ns, g = kcfg.n_configs, kcfg.n_shards, kcfg.n_groups
+    kt, km = jax.random.split(jax.random.fold_in(key, _S_CFGGEN))
+    gaps = jax.random.randint(
+        kt, (ncfg,), kcfg.cfg_interval // 2, kcfg.cfg_interval * 3 // 2 + 1,
+        dtype=I32,
+    )
+    cfg_tick = jnp.cumsum(gaps) - gaps[0]  # config 0 active from tick 0
+    owner0 = jnp.arange(ns, dtype=I32) % g
+
+    def body(owner, k):
+        ks, kg = jax.random.split(k)
+        s = jax.random.randint(ks, (), 0, ns, dtype=I32)
+        dst = jax.random.randint(kg, (), 0, g, dtype=I32)
+        nxt = jnp.where(jnp.arange(ns, dtype=I32) == s, dst, owner)
+        return nxt, nxt
+
+    _, owners = jax.lax.scan(body, owner0, jax.random.split(km, ncfg - 1))
+    cfg_owner = jnp.concatenate([owner0[None], owners], axis=0)
+    return cfg_tick, cfg_owner
+
+
+def init_shardkv_cluster(
+    cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array
+) -> ShardKvState:
+    g, n, ns, nc = kcfg.n_groups, cfg.n_nodes, kcfg.n_shards, kcfg.n_clients
+    gkeys = jax.vmap(lambda i: jax.random.fold_in(key, _S_GROUP + i))(
+        jnp.arange(g)
+    )
+    rafts = jax.vmap(functools.partial(init_cluster, cfg))(gkeys)
+    cfg_tick, cfg_owner = _gen_schedule(cfg, kcfg, key)
+    phase0 = jnp.where(
+        cfg_owner[0][None, None, :] == jnp.arange(g, dtype=I32)[:, None, None],
+        OWNED, ABSENT,
+    ) * jnp.ones((g, n, ns), I32)
+    zgns = jnp.zeros((g, n, ns), I32)
+    zggs = jnp.zeros((g, g, ns), I32)
+    return ShardKvState(
+        rafts=rafts,
+        cfg_tick=cfg_tick,
+        cfg_owner=cfg_owner,
+        applied=jnp.zeros((g, n), I32),
+        node_cfg=jnp.zeros((g, n), I32),
+        phase=phase0,
+        key_hash=zgns, key_count=zgns,
+        last_seq=jnp.zeros((g, n, ns, nc), I32),
+        snap_cfg=jnp.zeros((g, n), I32),
+        snap_phase=phase0,
+        snap_hash=zgns, snap_count=zgns,
+        snap_last_seq=jnp.zeros((g, n, ns, nc), I32),
+        staged_cfg=jnp.full((g, ns), -1, I32),
+        staged_hash=jnp.zeros((g, ns), I32),
+        staged_count=jnp.zeros((g, ns), I32),
+        staged_last_seq=jnp.zeros((g, ns, nc), I32),
+        pull_req_t=zggs, pull_req_cfg=zggs,
+        pull_rsp_t=zggs, pull_rsp_cfg=zggs,
+        pull_rsp_hash=zggs, pull_rsp_count=zggs,
+        pull_rsp_last_seq=jnp.zeros((g, g, ns, nc), I32),
+        ack_t=zggs, ack_cfg=zggs,
+        clerk_seq=jnp.zeros((nc,), I32),
+        clerk_out=jnp.zeros((nc,), jnp.bool_),
+        clerk_shard=jnp.zeros((nc,), I32),
+        clerk_cfg=jnp.zeros((nc,), I32),
+        clerk_acked=jnp.zeros((nc,), I32),
+        w_frontier=jnp.zeros((g,), I32),
+        w_cfg=jnp.zeros((g,), I32),
+        w_phase=phase0[:, 0, :],
+        w_hash=jnp.zeros((g, ns), I32),
+        w_count=jnp.zeros((g, ns), I32),
+        w_last_seq=jnp.zeros((g, ns, nc), I32),
+        frz_cfg=jnp.full((ns,), -1, I32),
+        frz_hash=jnp.zeros((ns,), I32),
+        frz_count=jnp.zeros((ns,), I32),
+        frz_last_seq=jnp.zeros((ns, nc), I32),
+        truth_count=jnp.zeros((ns,), I32),
+        w_clerk_acked=jnp.zeros((nc,), I32),
+        installs_done=jnp.asarray(0, I32),
+        deletes_done=jnp.asarray(0, I32),
+        violations=jnp.asarray(0, I32),
+        first_violation_tick=jnp.asarray(-1, I32),
+    )
+
+
+def shardkv_step(
+    cfg: SimConfig, kcfg: ShardKvConfig, st: ShardKvState, cluster_key: jax.Array
+) -> ShardKvState:
+    """One lockstep tick of a whole deployment."""
+    assert cfg.p_client_cmd == 0.0, "shardkv layer owns command injection"
+    assert not cfg.compact_at_commit, (
+        "shardkv needs compact_at_commit=False (boundary = apply cursor)"
+    )
+    g, n, cap = kcfg.n_groups, cfg.n_nodes, cfg.log_cap
+    ns, nc = kcfg.n_shards, kcfg.n_clients
+    pre = st.rafts
+    gkeys = jax.vmap(lambda i: jax.random.fold_in(cluster_key, _S_GROUP + i))(
+        jnp.arange(g)
+    )
+    s = jax.vmap(functools.partial(step_cluster, cfg))(pre, gkeys)
+    t = s.tick[0]  # all groups tick in lockstep
+    key = jax.random.fold_in(cluster_key, t)
+    viol = jnp.asarray(0, I32)
+
+    active_cfg = jnp.sum((st.cfg_tick <= t).astype(I32)) - 1  # controller's view
+
+    applied, node_cfg, phase = st.applied, st.node_cfg, st.phase
+    key_hash, key_count, last_seq = st.key_hash, st.key_count, st.last_seq
+    snap_cfg, snap_phase = st.snap_cfg, st.snap_phase
+    snap_hash, snap_count = st.snap_hash, st.snap_count
+    snap_last_seq = st.snap_last_seq
+
+    # 1. Crash/restart: live service state resets to the node's own persisted
+    #    snapshot; replay from base rebuilds (kv.py pattern).
+    fresh = (~pre.alive & s.alive) | ~s.alive  # [G, N]
+    applied = jnp.where(fresh, s.base, applied)
+    node_cfg = jnp.where(fresh, snap_cfg, node_cfg)
+    phase = jnp.where(fresh[..., None], snap_phase, phase)
+    key_hash = jnp.where(fresh[..., None], snap_hash, key_hash)
+    key_count = jnp.where(fresh[..., None], snap_count, key_count)
+    last_seq = jnp.where(fresh[..., None, None], snap_last_seq, last_seq)
+
+    # 2. Compaction (base advanced without install): capture live tables as
+    #    the persisted snapshot (they equal the state at the new base, because
+    #    the boundary is the pre-tick apply cursor).
+    inst = s.snap_installed_src >= 0  # [G, N]
+    comp = (s.base != pre.base) & ~inst & s.alive
+    snap_cfg = jnp.where(comp, node_cfg, snap_cfg)
+    snap_phase = jnp.where(comp[..., None], phase, snap_phase)
+    snap_hash = jnp.where(comp[..., None], key_hash, snap_hash)
+    snap_count = jnp.where(comp[..., None], key_count, snap_count)
+    snap_last_seq = jnp.where(comp[..., None, None], last_seq, snap_last_seq)
+
+    # 3. Raft install-snapshot: adopt the in-group sender's persisted service
+    #    snapshot (one-hot over the node axis, per group).
+    me_n = jnp.arange(n, dtype=I32)
+    src_oh = me_n[None, None, :] == s.snap_installed_src[:, :, None]  # [G,N,Nsrc]
+
+    def adopt(snap):  # snap [G, N, ...] -> gathered over the src-node axis
+        extra = snap.ndim - 2  # trailing dims beyond [G, N]
+        w = src_oh.reshape(src_oh.shape + (1,) * extra)
+        return jnp.sum(jnp.where(w, snap[:, None], 0), axis=2)
+
+    applied = jnp.where(inst, s.base, applied)
+    node_cfg = jnp.where(inst, adopt(snap_cfg[..., None])[..., 0], node_cfg)
+    phase = jnp.where(inst[..., None], adopt(snap_phase), phase)
+    key_hash = jnp.where(inst[..., None], adopt(snap_hash), key_hash)
+    key_count = jnp.where(inst[..., None], adopt(snap_count), key_count)
+    last_seq = jnp.where(inst[..., None, None], adopt(snap_last_seq), last_seq)
+    snap_cfg = jnp.where(inst, node_cfg, snap_cfg)
+    snap_phase = jnp.where(inst[..., None], phase, snap_phase)
+    snap_hash = jnp.where(inst[..., None], key_hash, snap_hash)
+    snap_count = jnp.where(inst[..., None], key_count, snap_count)
+    snap_last_seq = jnp.where(inst[..., None, None], last_seq, snap_last_seq)
+
+    # ---------------------------------------------------------- apply machines
+    lane = jnp.arange(cap, dtype=I32)[None, None, :]
+    sh_lane = jnp.arange(ns, dtype=I32)
+    cl_lane = jnp.arange(nc, dtype=I32)
+    for _ in range(kcfg.apply_max):
+        can = s.alive & (applied < s.commit)  # [G, N]
+        pos = _slot(applied + 1, cap)
+        val = jnp.sum(jnp.where(lane == pos[..., None], s.log_val, 0), axis=-1)
+        kind, client, seq, shard, cfg_c, cfg_i = _unpack(kcfg, val)
+        client = jnp.clip(client, 0, nc - 1)
+        sh_oh = sh_lane[None, None, :] == shard[..., None]          # [G,N,NS]
+        cl_oh = cl_lane[None, None, :] == client[..., None]          # [G,N,NC]
+
+        # APPEND: accept iff the shard is OWNED here and the seq is fresh.
+        cur_phase = jnp.sum(jnp.where(sh_oh, phase, 0), axis=-1)
+        owned = cur_phase == OWNED
+        prev_seq = jnp.sum(
+            jnp.where(sh_oh[..., None] & cl_oh[..., None, :], last_seq, 0),
+            axis=(-2, -1),
+        )
+        is_app = can & (kind == _APPEND)
+        acc = is_app & owned & (seq > prev_seq)
+        upd = sh_oh & acc[..., None]
+        key_hash = jnp.where(upd, key_hash * 1000003 + val[..., None], key_hash)
+        key_count = jnp.where(upd, key_count + 1, key_count)
+        last_seq = jnp.where(
+            upd[..., None] & cl_oh[..., None, :],
+            jnp.maximum(last_seq, seq[..., None, None]), last_seq,
+        )
+
+        # CONFIG c+1: adopt iff it is exactly node_cfg+1 (in-order). Lost
+        # shards freeze (unless bug), gained shards start pulling; a shard
+        # gained in config 0..  that nobody previously owned starts OWNED.
+        is_cfg = can & (kind == _CONFIG) & (cfg_c == node_cfg + 1)
+        # cfg_c is [G,N]; st.cfg_owner is [NCFG, NS] -> result [G,N,NS]
+        new_owner = st.cfg_owner[jnp.clip(cfg_c, 0, kcfg.n_configs - 1)]
+        my_g = jnp.arange(g, dtype=I32)[:, None, None]
+        # gains only from ABSENT: a leader may not adopt a config that
+        # re-gains a shard it still holds FROZEN (the older migration still
+        # needs that copy) — the can_advance gate below delays the CONFIG
+        # append until the DELETE landed, so at apply time the phase is
+        # ABSENT. Turning FROZEN into PULLING here instead would destroy the
+        # frozen copy and deadlock the older migration against the newer one.
+        gains = (new_owner == my_g) & (phase == ABSENT)
+        loses = (new_owner != my_g) & (phase == OWNED)
+        prev_owner = st.cfg_owner[jnp.clip(cfg_c - 1, 0, kcfg.n_configs - 1)]
+        from_nobody = prev_owner == new_owner  # unchanged owner: no migration
+        phase = jnp.where(
+            is_cfg[..., None] & gains,
+            jnp.where(from_nobody, OWNED, PULLING), phase,
+        )
+        if not kcfg.bug_skip_freeze:
+            phase = jnp.where(is_cfg[..., None] & loses, FROZEN, phase)
+        node_cfg = jnp.where(is_cfg, cfg_c, node_cfg)
+
+        # INSTALL(s, c): adopt the staged payload (group-level staging models
+        # the payload riding the entry); only meaningful while PULLING, and
+        # only when the staging still holds THIS config's payload — a node
+        # replaying an old INSTALL after the group re-pulled the shard at a
+        # later config must skip it (it converges at the later INSTALL; the
+        # walker's frz_cfg gate is the oracle-side mirror of this guard).
+        stg_cfg_at = jnp.sum(
+            jnp.where(sh_oh, st.staged_cfg[:, None, :], 0), axis=-1
+        )  # [G, N]
+        is_inst = can & (kind == _INSTALL) & (stg_cfg_at == cfg_i)
+        inst_upd = sh_oh & is_inst[..., None] & (phase == PULLING)
+        stg_hash = st.staged_hash[:, None, :] * jnp.ones((1, n, 1), I32)
+        stg_count = st.staged_count[:, None, :] * jnp.ones((1, n, 1), I32)
+        key_hash = jnp.where(inst_upd, stg_hash, key_hash)
+        key_count = jnp.where(inst_upd, stg_count, key_count)
+        if kcfg.bug_drop_dup_table:
+            last_seq = jnp.where(inst_upd[..., None], 0, last_seq)
+        else:
+            last_seq = jnp.where(
+                inst_upd[..., None],
+                st.staged_last_seq[:, None, :, :] * jnp.ones((1, n, 1, 1), I32),
+                last_seq,
+            )
+        phase = jnp.where(inst_upd, OWNED, phase)
+
+        # DELETE(s, c): drop the frozen copy (challenge-1 GC).
+        is_del = can & (kind == _DELETE)
+        del_upd = sh_oh & is_del[..., None] & (phase == FROZEN)
+        phase = jnp.where(del_upd, ABSENT, phase)
+        key_hash = jnp.where(del_upd, 0, key_hash)
+        key_count = jnp.where(del_upd, 0, key_count)
+        last_seq = jnp.where(del_upd[..., None], 0, last_seq)
+
+        applied = jnp.where(can, applied + 1, applied)
+
+    # ------------------------------------------------------------ truth walker
+    # Advance each group's canonical state machine along its committed shadow
+    # log (bounded entries/tick; the walker chases the frontier and the
+    # divergence oracle gates on exact frontier match).
+    w_frontier, w_cfg = st.w_frontier, st.w_cfg
+    w_phase, w_hash, w_count = st.w_phase, st.w_hash, st.w_count
+    w_last_seq = st.w_last_seq
+    frz_cfg, frz_hash = st.frz_cfg, st.frz_hash
+    frz_count, frz_last_seq = st.frz_count, st.frz_last_seq
+    truth_count, w_clerk_acked = st.truth_count, st.w_clerk_acked
+    installs_done, deletes_done = st.installs_done, st.deletes_done
+    sh_abs = jax.vmap(lambda b: _lane_abs(b, cap))(s.shadow_base)  # [G, cap]
+    lane_g = jnp.arange(cap, dtype=I32)[None, :]
+    my_gv = jnp.arange(g, dtype=I32)  # [G]
+    for _ in range(kcfg.walk_max):
+        canw = w_frontier < s.shadow_len  # [G]
+        # value at shadow index w_frontier+1 (one-hot over lanes; a lane
+        # outside the window means the walker fell > cap behind — treated as
+        # a zero value that matches nothing; tests keep walk_max high enough)
+        posw = _slot(w_frontier + 1, cap)
+        in_win = jnp.any(
+            (lane_g == posw[:, None]) & (sh_abs == (w_frontier + 1)[:, None]),
+            axis=1,
+        )
+        val = jnp.sum(
+            jnp.where(lane_g == posw[:, None], s.shadow_val, 0), axis=1
+        )
+        canw = canw & in_win
+        kind, client, seq, shard, cfg_c, cfg_i = _unpack(kcfg, val)
+        client = jnp.clip(client, 0, nc - 1)
+        sh_oh = sh_lane[None, :] == shard[:, None]   # [G, NS]
+        cl_oh = cl_lane[None, :] == client[:, None]  # [G, NC]
+
+        cur_phase = jnp.sum(jnp.where(sh_oh, w_phase, 0), axis=-1)
+        # Cross-group walk ordering: a dst group's INSTALL may reach the
+        # walker before the src group's freeze was walked (walkers advance
+        # independently). The freeze-snapshot copy would then be stale, so the
+        # walker STALLS this group's walk until the snapshot for exactly this
+        # (shard, config) exists. No circular wait: the shard's migration
+        # chain follows config order, and each group's own log orders its
+        # install before its subsequent freeze.
+        frz_at = jnp.sum(jnp.where(sh_oh, frz_cfg[None, :], 0), axis=-1)
+        stall = (
+            canw & (kind == _INSTALL) & (cur_phase == PULLING)
+            & (frz_at != cfg_i)
+        )
+        canw = canw & ~stall
+        prev_seq = jnp.sum(
+            jnp.where(sh_oh[..., None] & cl_oh[:, None, :], w_last_seq, 0),
+            axis=(-2, -1),
+        )
+        is_app = canw & (kind == _APPEND)
+        acc = is_app & (cur_phase == OWNED) & (seq > prev_seq)
+        upd = sh_oh & acc[:, None]
+        w_hash = jnp.where(upd, w_hash * 1000003 + val[:, None], w_hash)
+        w_count = jnp.where(upd, w_count + 1, w_count)
+        w_last_seq = jnp.where(
+            upd[..., None] & cl_oh[:, None, :],
+            jnp.maximum(w_last_seq, seq[:, None, None]), w_last_seq,
+        )
+        truth_count = truth_count + jnp.sum(
+            (sh_lane[None, :] == shard[:, None]) & acc[:, None], axis=0,
+            dtype=I32,
+        )
+        # the walker's accept IS the service's reply: ack the clerk
+        w_clerk_acked = jnp.maximum(
+            w_clerk_acked,
+            jnp.max(jnp.where(cl_oh & acc[:, None], seq[:, None], 0), axis=0),
+        )
+
+        is_cfg = canw & (kind == _CONFIG) & (cfg_c == w_cfg + 1)
+        new_owner = st.cfg_owner[jnp.clip(cfg_c, 0, kcfg.n_configs - 1)]  # [G,NS]
+        prev_owner = st.cfg_owner[jnp.clip(cfg_c - 1, 0, kcfg.n_configs - 1)]
+        gains = (new_owner == my_gv[:, None]) & (w_phase == ABSENT)
+        loses = (new_owner != my_gv[:, None]) & (w_phase == OWNED)
+        from_nobody = prev_owner == new_owner
+        freeze = is_cfg[:, None] & loses
+        # snapshot the frozen state for the INSTALL-side dup-table copy
+        any_freeze = jnp.any(freeze, axis=0)  # [NS]
+        frz_cfg = jnp.where(any_freeze, jnp.max(jnp.where(freeze, cfg_c[:, None], -1), axis=0), frz_cfg)
+        frz_hash = jnp.where(any_freeze, jnp.sum(jnp.where(freeze, w_hash, 0), axis=0), frz_hash)
+        frz_count = jnp.where(any_freeze, jnp.sum(jnp.where(freeze, w_count, 0), axis=0), frz_count)
+        frz_last_seq = jnp.where(
+            any_freeze[:, None],
+            jnp.sum(jnp.where(freeze[..., None], w_last_seq, 0), axis=0),
+            frz_last_seq,
+        )
+        w_phase = jnp.where(
+            is_cfg[:, None] & gains,
+            jnp.where(from_nobody, OWNED, PULLING), w_phase,
+        )
+        w_phase = jnp.where(freeze, FROZEN, w_phase)
+        w_cfg = jnp.where(is_cfg, cfg_c, w_cfg)
+
+        is_inst = canw & (kind == _INSTALL)
+        inst_upd = sh_oh & is_inst[:, None] & (w_phase == PULLING)
+        w_hash = jnp.where(inst_upd, frz_hash[None, :], w_hash)
+        w_count = jnp.where(inst_upd, frz_count[None, :], w_count)
+        w_last_seq = jnp.where(
+            inst_upd[..., None], frz_last_seq[None, :, :], w_last_seq
+        )
+        w_phase = jnp.where(inst_upd, OWNED, w_phase)
+        installs_done += jnp.sum(inst_upd, dtype=I32)
+
+        is_del = canw & (kind == _DELETE)
+        del_upd = sh_oh & is_del[:, None] & (w_phase == FROZEN)
+        w_phase = jnp.where(del_upd, ABSENT, w_phase)
+        w_hash = jnp.where(del_upd, 0, w_hash)
+        w_count = jnp.where(del_upd, 0, w_count)
+        w_last_seq = jnp.where(del_upd[..., None], 0, w_last_seq)
+        deletes_done += jnp.sum(del_upd, dtype=I32)
+
+        w_frontier = jnp.where(canw, w_frontier + 1, w_frontier)
+
+    # ----------------------------------------------------------------- oracles
+    # Divergence: an alive node at exactly the walker frontier must equal it.
+    at_frontier = s.alive & (applied == w_frontier[:, None])  # [G, N]
+    m_state = (
+        (phase == w_phase[:, None, :])
+        & (key_hash == w_hash[:, None, :])
+        & (key_count == w_count[:, None, :])
+    )
+    m_dup = jnp.all(last_seq == w_last_seq[:, None, :, :], axis=-1)
+    m_cfg = node_cfg == w_cfg[:, None]
+    diverge = at_frontier & ~(jnp.all(m_state & m_dup, axis=-1) & m_cfg)
+    viol |= jnp.where(jnp.any(diverge), VIOLATION_SHARD_DIVERGE, 0)
+    # Ownership exclusivity (walker-level; freeze-before-pull forbids dual own).
+    owned_ct = jnp.sum((w_phase == OWNED).astype(I32), axis=0)  # [NS]
+    viol |= jnp.where(jnp.any(owned_ct > 1), VIOLATION_SHARD_OWNERSHIP, 0)
+    # Storage (challenge 1): deleted means DELETED — a node holding state for
+    # a shard whose phase is ABSENT is a GC leak (the bytes challenge 1
+    # bounds). Chained migrations make any per-tick bound on frozen-copy
+    # counts unsound (acks lag arbitrarily), so eventual GC completion is
+    # asserted at quiesce by the tests via the report's frozen_left/deletes
+    # fields — the analogue of the reference's end-of-test total-storage
+    # assertion (shardkv/tests.rs:477-488).
+    leak = s.alive[..., None] & (phase == ABSENT) & (
+        (key_hash != 0) | (key_count != 0)
+    )
+    viol |= jnp.where(jnp.any(leak), VIOLATION_SHARD_STORAGE, 0)
+
+    # ------------------------------------------------- inter-group mailboxes
+    # Leaders of each group (there may transiently be several; raft dedups the
+    # resulting marker entries via apply-side guards).
+    is_lead = s.alive & (s.role == LEADER)  # [G, N]
+    lead_any = jnp.any(is_lead, axis=1)     # [G]
+    # leader-held service view: take the max-applied leader node per group
+    lead_score = jnp.where(is_lead, applied, -1)
+    lead_node = jnp.argmax(lead_score, axis=1)  # [G]
+    ln_oh = me_n[None, :] == lead_node[:, None]  # [G, N]
+
+    def lead_view(x):  # x [G, N, ...] -> [G, ...] at the leader node
+        extra = x.ndim - 2
+        w = ln_oh.reshape(ln_oh.shape + (1,) * extra)
+        return jnp.sum(jnp.where(w, x, 0), axis=1)
+
+    l_phase = lead_view(phase)        # [G, NS]
+    l_cfg = lead_view(node_cfg[..., None])[..., 0]  # [G]
+    l_hash, l_count = lead_view(key_hash), lead_view(key_count)
+    l_last_seq = lead_view(last_seq)  # [G, NS, NC]
+
+    kp = jax.random.split(jax.random.fold_in(key, _S_PULL), 4)
+    knet = jax.random.split(jax.random.fold_in(key, _S_NET_PULL), 3)
+
+    # Deliver pull requests: src leader answers for FROZEN shards at the
+    # requested config with its own (frozen) state.
+    req_arr = st.pull_req_t == t  # [dst, src, NS] arrives at src
+    src_frozen = (l_phase == FROZEN)[None, :, :]  # src's leader view
+    src_cfg_ok = (l_cfg[None, :, None] >= st.pull_req_cfg) & lead_any[None, :, None]
+    answer = req_arr & src_frozen & src_cfg_ok
+    delay = jax.random.randint(
+        knet[0], (g, g, ns), kcfg.pull_delay_min, kcfg.pull_delay_max + 1,
+        dtype=I32,
+    )
+    lost = jax.random.bernoulli(knet[1], kcfg.pull_loss, (g, g, ns))
+    send_rsp = answer & ~lost
+    pull_rsp_t = jnp.where(send_rsp, t + delay, st.pull_rsp_t)
+    pull_rsp_cfg = jnp.where(send_rsp, st.pull_req_cfg, st.pull_rsp_cfg)
+    pull_rsp_hash = jnp.where(send_rsp, l_hash[None, :, :], st.pull_rsp_hash)
+    pull_rsp_count = jnp.where(send_rsp, l_count[None, :, :], st.pull_rsp_count)
+    pull_rsp_last_seq = jnp.where(
+        send_rsp[..., None], l_last_seq[None, :, :, :], st.pull_rsp_last_seq
+    )
+    pull_req_t = jnp.where(req_arr, 0, st.pull_req_t)
+
+    # Deliver pull responses at dst: stage the payload (overwrite is fine —
+    # frozen state is immutable per config transition).
+    rsp_arr = pull_rsp_t == t  # [dst, src, NS]
+    got = jnp.any(rsp_arr, axis=1)  # [dst, NS]
+    pick = jnp.where(rsp_arr, 1, 0)
+    staged_cfg = jnp.where(
+        got, jnp.max(jnp.where(rsp_arr, pull_rsp_cfg, -1), axis=1), st.staged_cfg
+    )
+    staged_hash = jnp.where(
+        got, jnp.sum(pull_rsp_hash * pick, axis=1), st.staged_hash
+    )
+    staged_count = jnp.where(
+        got, jnp.sum(pull_rsp_count * pick, axis=1), st.staged_count
+    )
+    staged_last_seq = jnp.where(
+        got[..., None],
+        jnp.sum(pull_rsp_last_seq * pick[..., None], axis=1),
+        st.staged_last_seq,
+    )
+    pull_rsp_t = jnp.where(rsp_arr, 0, pull_rsp_t)
+
+    # Deliver acks at the old owner: leader appends DELETE (guarded at apply).
+    ack_arr = st.ack_t == t  # [old_owner(dst), new_owner(src), NS]
+    ack_del = jnp.any(ack_arr, axis=1)  # [G, NS] old owner should delete
+    ack_del_cfg = jnp.max(jnp.where(ack_arr, st.ack_cfg, 0), axis=1)
+    ack_t = jnp.where(ack_arr, 0, st.ack_t)
+
+    # ------------------------------------------- leader protocol transitions
+    # (a) poll the controller: append CONFIG(node_cfg+1) once migrations for
+    #     the current config are complete (no PULLING shard at the leader).
+    poll = jax.random.bernoulli(kp[0], kcfg.p_cfg_learn, (g,))
+    # Advance gate: all pulls for the current config done, AND no FROZEN
+    # shard that the next config would hand back to us — its frozen copy
+    # still serves the older migration; the DELETE (driven by the new
+    # owner's ack) must land first. No circular wait: the dest's install
+    # only needs the frozen copy to exist, not our config progress.
+    next_owner_l = st.cfg_owner[
+        jnp.clip(l_cfg + 1, 0, kcfg.n_configs - 1)
+    ]  # [G, NS]
+    regain_blocked = jnp.any(
+        (l_phase == FROZEN) & (next_owner_l == my_gv[:, None]), axis=1
+    )
+    can_advance = (
+        lead_any & poll
+        & (l_cfg < active_cfg)
+        & ~jnp.any(l_phase == PULLING, axis=1)
+        & ~regain_blocked
+    )
+    # (b) pull requests for PULLING shards -> previous owner.
+    want_pull = (l_phase == PULLING) & lead_any[:, None]  # [G(dst), NS]
+    pull_draw = jax.random.bernoulli(kp[1], kcfg.p_pull, (g, ns))
+    prev_owner_l = st.cfg_owner[jnp.clip(l_cfg - 1, 0, kcfg.n_configs - 1)]  # [G, NS]
+    do_pull = want_pull & pull_draw
+    tgt_oh = prev_owner_l[:, None, :] == my_gv[None, :, None]  # [dst, src, NS]
+    delay2 = jax.random.randint(
+        knet[2], (g, g, ns), kcfg.pull_delay_min, kcfg.pull_delay_max + 1,
+        dtype=I32,
+    )
+    lost2 = jax.random.bernoulli(kp[2], kcfg.pull_loss, (g, g, ns))
+    send_req = do_pull[:, None, :] & tgt_oh & ~lost2
+    pull_req_t = jnp.where(send_req, t + delay2, pull_req_t)
+    pull_req_cfg = jnp.where(
+        send_req, l_cfg[:, None, None], st.pull_req_cfg
+    )
+    # (c) acks for shards owned in the current config that were migrated in
+    #     (previous owner differs): idempotent retries; DELETE guards dedup.
+    migrated_in = (l_phase == OWNED) & (prev_owner_l != my_gv[:, None])
+    ack_draw = jax.random.bernoulli(kp[3], kcfg.p_pull, (g, ns))
+    do_ack = migrated_in & ack_draw & lead_any[:, None]
+    send_ack = do_ack[:, None, :] & tgt_oh  # to previous owner, reliable-ish
+    ack_t = jnp.where(send_ack.transpose(1, 0, 2), t + 1, ack_t)
+    ack_cfg = jnp.where(
+        send_ack.transpose(1, 0, 2), l_cfg[None, :, None], st.ack_cfg
+    )
+
+    # --------------------------------------------------------------- clerks
+    kc = jax.random.split(jax.random.fold_in(key, _S_CLERK), 5)
+    newly = st.clerk_out & (w_clerk_acked >= st.clerk_seq)
+    clerk_acked = jnp.where(newly, st.clerk_seq, st.clerk_acked)
+    clerk_out = st.clerk_out & ~newly
+    learn = jax.random.bernoulli(kc[0], kcfg.p_cfg_learn, (nc,))
+    clerk_cfg = jnp.where(
+        learn, active_cfg, st.clerk_cfg
+    )
+    start = (
+        ~clerk_out
+        & jax.random.bernoulli(kc[1], kcfg.p_op, (nc,))
+        & (st.clerk_seq < _SEQ_LIM - 1)
+    )
+    clerk_seq = jnp.where(start, st.clerk_seq + 1, st.clerk_seq)
+    clerk_shard = jnp.where(
+        start, jax.random.randint(kc[2], (nc,), 0, ns, dtype=I32),
+        st.clerk_shard,
+    )
+    clerk_out = clerk_out | start
+    retry = clerk_out & (start | jax.random.bernoulli(kc[3], kcfg.p_retry, (nc,)))
+    tgt_node = jax.random.randint(kc[4], (nc,), 0, n, dtype=I32)
+
+    # ---------------------------- service-layer log appends (post-raft-tick)
+    log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
+
+    def append_at(mask_gn, value_gn, log_term, log_val, log_len):
+        """Append value at nodes where mask (leader-gated by caller). Room is
+        re-derived from the running log_len — several appends can land at one
+        node in one tick."""
+        ok = mask_gn & (log_len - s.base < cap) & s.alive
+        hit = ok[..., None] & (
+            jnp.arange(cap, dtype=I32)[None, None, :]
+            == _slot(log_len + 1, cap)[..., None]
+        )
+        log_term = jnp.where(hit, s.term[..., None], log_term)
+        log_val = jnp.where(hit, value_gn[..., None], log_val)
+        log_len = jnp.where(ok, log_len + 1, log_len)
+        return log_term, log_val, log_len
+
+    # CONFIG advance at the (single chosen) leader node.
+    cfg_val = _pack_config(node_cfg + 1)  # [G, N]
+    log_term, log_val, log_len = append_at(
+        ln_oh & can_advance[:, None] & is_lead, cfg_val,
+        log_term, log_val, log_len,
+    )
+    # INSTALL entries: leader appends for PULLING shards whose staging holds a
+    # payload for its current config.
+    have_stage = staged_cfg == l_cfg[:, None]
+    inst_ready = want_pull & have_stage  # [G, NS]
+    for sh in range(ns):
+        v = _pack_install(kcfg, node_cfg, jnp.asarray(sh, I32))
+        log_term, log_val, log_len = append_at(
+            ln_oh & inst_ready[:, sh:sh + 1] & is_lead, v,
+            log_term, log_val, log_len,
+        )
+    # DELETE entries at the old owner on ack.
+    for sh in range(ns):
+        v = _pack_delete(kcfg, ack_del_cfg[:, sh][:, None], jnp.asarray(sh, I32))
+        log_term, log_val, log_len = append_at(
+            ln_oh & ack_del[:, sh:sh + 1] & is_lead, v,
+            log_term, log_val, log_len,
+        )
+    # Client ops at the believed owner's targeted node (leader-gated; a wrong
+    # or stale guess commits nothing or a rejected entry — the clerk retries).
+    owner_of = st.cfg_owner[jnp.clip(clerk_cfg, 0, kcfg.n_configs - 1)]  # [NC, NS]
+    for c in range(nc):
+        shard_c = clerk_shard[c]
+        grp = jnp.sum(
+            jnp.where(sh_lane == shard_c, owner_of[c], 0)
+        )  # owner group per clerk's believed config
+        sel = (
+            (jnp.arange(g, dtype=I32)[:, None] == grp)
+            & (me_n[None, :] == tgt_node[c])
+            & is_lead
+        )
+        v = _pack_append(kcfg, jnp.asarray(c, I32), clerk_seq[c], shard_c)
+        log_term, log_val, log_len = append_at(
+            sel & retry[c], jnp.broadcast_to(v, (g, n)),
+            log_term, log_val, log_len,
+        )
+
+    violations = st.violations | viol
+    first_violation_tick = jnp.where(
+        (st.first_violation_tick < 0) & (viol != 0), t, st.first_violation_tick
+    )
+
+    rafts = s._replace(
+        log_term=log_term, log_val=log_val, log_len=log_len,
+        compact_floor=applied,
+    )
+    return ShardKvState(
+        rafts=rafts,
+        cfg_tick=st.cfg_tick, cfg_owner=st.cfg_owner,
+        applied=applied, node_cfg=node_cfg, phase=phase,
+        key_hash=key_hash, key_count=key_count, last_seq=last_seq,
+        snap_cfg=snap_cfg, snap_phase=snap_phase,
+        snap_hash=snap_hash, snap_count=snap_count,
+        snap_last_seq=snap_last_seq,
+        staged_cfg=staged_cfg, staged_hash=staged_hash,
+        staged_count=staged_count, staged_last_seq=staged_last_seq,
+        pull_req_t=pull_req_t, pull_req_cfg=pull_req_cfg,
+        pull_rsp_t=pull_rsp_t, pull_rsp_cfg=pull_rsp_cfg,
+        pull_rsp_hash=pull_rsp_hash, pull_rsp_count=pull_rsp_count,
+        pull_rsp_last_seq=pull_rsp_last_seq,
+        ack_t=ack_t, ack_cfg=ack_cfg,
+        clerk_seq=clerk_seq, clerk_out=clerk_out,
+        clerk_shard=clerk_shard, clerk_cfg=clerk_cfg,
+        clerk_acked=clerk_acked,
+        w_frontier=w_frontier, w_cfg=w_cfg, w_phase=w_phase,
+        w_hash=w_hash, w_count=w_count, w_last_seq=w_last_seq,
+        frz_cfg=frz_cfg, frz_hash=frz_hash,
+        frz_count=frz_count, frz_last_seq=frz_last_seq,
+        truth_count=truth_count, w_clerk_acked=w_clerk_acked,
+        installs_done=installs_done, deletes_done=deletes_done,
+        violations=violations, first_violation_tick=first_violation_tick,
+    )
+
+
+# ------------------------------------------------------------------- drivers
+class ShardKvFuzzReport(NamedTuple):
+    violations: np.ndarray            # deployment-level bitmask
+    raft_violations: np.ndarray       # OR over the deployment's groups
+    first_violation_tick: np.ndarray
+    acked_ops: np.ndarray
+    installs: np.ndarray              # completed shard migrations
+    deletes: np.ndarray               # completed shard GCs
+    final_cfg: np.ndarray             # min walker config across groups
+    owned_copies: np.ndarray          # per-deployment max owners of any shard
+    frozen_left: np.ndarray           # frozen copies remaining at the end
+
+    @property
+    def n_violating(self) -> int:
+        return int(((self.violations | self.raft_violations) != 0).sum())
+
+    def violating_clusters(self) -> np.ndarray:
+        return np.nonzero((self.violations | self.raft_violations) != 0)[0]
+
+
+def make_shardkv_fuzz_fn(
+    cfg: SimConfig,
+    kcfg: ShardKvConfig,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+):
+    """Build a jitted fn(seed) -> final batched ShardKvState."""
+    constraint = None
+    if mesh is not None:
+        constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
+
+    def run(seed) -> ShardKvState:
+        base = jax.random.PRNGKey(seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(n_clusters)
+        )
+        states = jax.vmap(functools.partial(init_shardkv_cluster, cfg, kcfg))(keys)
+        if constraint is not None:
+            states = jax.lax.with_sharding_constraint(
+                states, jax.tree.map(lambda _: constraint, states)
+            )
+            keys = jax.lax.with_sharding_constraint(keys, constraint)
+
+        def body(carry, _):
+            nxt = jax.vmap(functools.partial(shardkv_step, cfg, kcfg))(carry, keys)
+            return nxt, None
+
+        final, _ = jax.lax.scan(body, states, None, length=n_ticks)
+        return final
+
+    return jax.jit(run)
+
+
+def shardkv_report(final: ShardKvState) -> ShardKvFuzzReport:
+    w_phase = np.asarray(final.w_phase)  # [D, G, NS]
+    owned = (w_phase == OWNED).sum(axis=1)    # [D, NS]
+    frozen = (w_phase == FROZEN).sum(axis=1)  # [D, NS]
+    return ShardKvFuzzReport(
+        violations=np.asarray(final.violations),
+        raft_violations=np.bitwise_or.reduce(
+            np.asarray(final.rafts.violations).reshape(
+                np.asarray(final.violations).shape[0], -1
+            ),
+            axis=1,
+        ),
+        first_violation_tick=np.asarray(final.first_violation_tick),
+        acked_ops=np.asarray(final.clerk_acked.sum(axis=-1)),
+        installs=np.asarray(final.installs_done),
+        deletes=np.asarray(final.deletes_done),
+        final_cfg=np.asarray(final.w_cfg.min(axis=-1)),
+        owned_copies=owned.max(axis=-1),
+        frozen_left=frozen.sum(axis=-1),
+    )
+
+
+def shardkv_fuzz(
+    cfg: SimConfig,
+    kcfg: ShardKvConfig,
+    seed: int,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+) -> ShardKvFuzzReport:
+    fn = make_shardkv_fuzz_fn(cfg, kcfg, n_clusters, n_ticks, mesh=mesh)
+    final = jax.block_until_ready(fn(jnp.asarray(seed, jnp.uint32)))
+    return shardkv_report(final)
