@@ -279,6 +279,13 @@ class CtrlerClerk {
   Task<void> leave(std::vector<Gid> gids) {
     return drop(core_.call(CtrlOp::leave(std::move(gids))));
   }
+  // DEVIATION from the reference (which applies Move verbatim,
+  // shard_ctrler/server.rs): a Move targeting a gid that never joined is
+  // silently DROPPED — it commits through raft but produces no new config
+  // (see the apply-side guard above) because downstream shardkv would try to
+  // pull the shard from an owner with no servers and wedge. A caller that
+  // needs to distinguish applied-from-rejected should query() and compare
+  // config numbers.
   Task<void> move_(uint64_t shard, Gid gid) {
     return drop(core_.call(CtrlOp::move_(shard, gid)));
   }
