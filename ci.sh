@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# One-command CI: reproduces the full green state locally.
+# Mirrors the reference's CI split (/root/reference/.github/workflows/ci.yml:11-43
+# build+lint job, test.yml:20-26 test job) for this framework's two backends:
+#
+#   1. C++ build (Release) + full 69-test suite on 2 seeds
+#   2. C++ determinism double-run (trace-hash compare; the madsim
+#      MADSIM_TEST_CHECK_DETERMINISTIC analogue, reference README.md:42-87)
+#   3. C++ ASan build + suite (memory safety for the coroutine runtime)
+#   4. Python/TPU-sim suite on the 8-device virtual CPU mesh
+#   5. Bench smoke (small cluster batch; CPU unless a TPU is attached)
+#
+# Usage: ./ci.sh [--fast]   (--fast skips ASan and the second seed)
+set -euo pipefail
+cd "$(dirname "$0")"
+FAST=${1:-}
+
+echo "== [1/5] C++ Release build + tests (seed 12345, 2 seeds)"
+cmake -S cpp -B build -G Ninja -DCMAKE_BUILD_TYPE=Release >/dev/null
+ninja -C build >/dev/null
+MADTPU_TEST_SEED=12345 MADTPU_TEST_NUM=$([ "$FAST" = "--fast" ] && echo 1 || echo 2) \
+  ./build/madtpu_tests | tail -1
+
+echo "== [2/5] C++ determinism double-run"
+MADTPU_TEST_SEED=424242 MADTPU_TEST_CHECK_DETERMINISTIC=1 \
+  ./build/madtpu_tests | tail -1
+
+if [ "$FAST" != --fast ]; then
+  echo "== [3/5] C++ ASan build + tests"
+  cmake -S cpp -B build-asan -G Ninja -DCMAKE_BUILD_TYPE=Debug \
+    -DCMAKE_CXX_FLAGS="-fsanitize=address,undefined -fno-omit-frame-pointer" \
+    -DCMAKE_EXE_LINKER_FLAGS="-fsanitize=address,undefined" >/dev/null
+  ninja -C build-asan >/dev/null
+  MADTPU_TEST_SEED=12345 ./build-asan/madtpu_tests | tail -1
+else
+  echo "== [3/5] skipped (--fast)"
+fi
+
+echo "== [4/5] Python/TPU-sim suite (8-device virtual CPU mesh)"
+python -m pytest tests/ --ignore tests/test_cpp_suite.py -q
+
+echo "== [5/5] bench smoke (1024 clusters x 128 ticks)"
+python bench.py 1024 128
+
+echo "CI GREEN"
