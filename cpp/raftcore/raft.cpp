@@ -188,6 +188,8 @@ bool Raft::cond_install_snapshot(uint64_t last_term, uint64_t last_index,
   } else {
     log_.clear();
   }
+  MT_LOG("raft", "node %zu installs snapshot through index %llu", me_,
+         (unsigned long long)last_index);
   snap_last_index_ = last_index;
   snap_last_term_ = last_term;
   snap_data_ = std::move(data);
@@ -224,6 +226,8 @@ Task<void> Raft::election_loop(std::shared_ptr<Raft> self) {
 
 void Raft::start_election() {
   term_++;
+  MT_LOG("raft", "node %zu starts election for term %llu", me_,
+         (unsigned long long)term_);
   role_ = Role::Candidate;
   voted_for_ = (int)me_;
   votes_ = 1;
@@ -254,6 +258,8 @@ Task<void> Raft::vote_task(std::shared_ptr<Raft> self, Addr peer,
 }
 
 void Raft::become_leader() {
+  MT_LOG("raft", "node %zu becomes leader of term %llu (log %llu)", me_,
+         (unsigned long long)term_, (unsigned long long)last_index());
   role_ = Role::Leader;
   leader_hint_ = (int)me_;
   for (size_t p = 0; p < peers_.size(); p++) {
@@ -273,6 +279,8 @@ void Raft::step_down(uint64_t new_term) {
   // granting a vote or hearing from the current-term leader (Raft §5.2);
   // resetting here would let an unelectable high-term disrupter postpone
   // re-election indefinitely.
+  MT_LOG("raft", "node %zu steps down to term %llu", me_,
+         (unsigned long long)new_term);
   term_ = new_term;
   role_ = Role::Follower;
   voted_for_ = -1;
@@ -368,6 +376,8 @@ void Raft::advance_commit() {
   // only commit entries from the current term (Raft §5.4.2, Figure 8)
   if (majority_match > commit_ && majority_match > snap_last_index_ &&
       term_at(majority_match) == term_) {
+    MT_LOG("raft", "leader %zu advances commit %llu -> %llu", me_,
+           (unsigned long long)commit_, (unsigned long long)majority_match);
     commit_ = majority_match;
     apply_committed();
   }

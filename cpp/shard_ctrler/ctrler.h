@@ -117,6 +117,8 @@ struct ShardInfo {
         return configs[n];
       }
       case CtrlOp::Kind::Join: {
+        MT_LOG("ctrler", "join -> config %llu",
+               (unsigned long long)(configs.back().num + 1));
         Config c = configs.back();
         c.num++;
         for (auto& [gid, srvs] : op.groups) c.groups[gid] = srvs;

@@ -459,6 +459,8 @@ class ShardKvServer : public std::enable_shared_from_this<ShardKvServer> {
         if (c.num != config_.num + 1) break;  // stale/duplicate proposal
         Config old = std::move(config_);
         config_ = std::move(c);
+        MT_LOG("shardkv", "gid %llu adopts config %llu",
+               (unsigned long long)gid_, (unsigned long long)config_.num);
         for (size_t s = 0; s < N_SHARDS; s++) {
           bool was = old.shards[s] == gid_;
           bool now = config_.shards[s] == gid_;
@@ -492,6 +494,9 @@ class ShardKvServer : public std::enable_shared_from_this<ShardKvServer> {
           break;  // duplicate install
         Dec sd(data);
         shards_[shard] = ShardData::dec(sd);
+        MT_LOG("shardkv", "gid %llu installs shard %llu at config %llu",
+               (unsigned long long)gid_, (unsigned long long)shard,
+               (unsigned long long)cfg_num);
         PullInfo src = std::move(it->second);
         pull_pending_.erase(it);
         need_ack_[{cfg_num, shard}] = std::move(src);
@@ -500,6 +505,9 @@ class ShardKvServer : public std::enable_shared_from_this<ShardKvServer> {
       case Cmd::Erase: {
         uint64_t cfg_num = d.u64();
         uint64_t shard = d.u64();
+        MT_LOG("shardkv", "gid %llu erases shard %llu (config %llu)",
+               (unsigned long long)gid_, (unsigned long long)shard,
+               (unsigned long long)cfg_num);
         outgoing_.erase({cfg_num, shard});
         break;
       }

@@ -1,4 +1,5 @@
 #include "simcore.h"
+#include <cstdarg>
 
 namespace simcore {
 
@@ -139,5 +140,21 @@ bool Sim::run(Task<void> main) {
   if (trace_observer()) trace_observer()(trace_hash_);
   return true;
 }
+
+namespace log_detail {
+void log_line(const char* module, const char* fmt, ...) {
+  Sim* sim = Sim::current();
+  if (sim)
+    std::fprintf(stderr, "[%9.4fs %-8s %s] ", sim->now() / 1e9, module,
+                 addr_str(sim->cur_addr()).c_str());
+  else
+    std::fprintf(stderr, "[          %-8s      ] ", module);
+  va_list args;
+  va_start(args, fmt);
+  std::vfprintf(stderr, fmt, args);
+  va_end(args);
+  std::fputc('\n', stderr);
+}
+}  // namespace log_detail
 
 }  // namespace simcore

@@ -66,6 +66,43 @@ constexpr uint64_t USEC = 1000;
 constexpr uint64_t MSEC = 1000 * USEC;
 constexpr uint64_t SEC = 1000 * MSEC;
 
+// ---------------------------------------------------------------- tracing
+// Per-module diagnostic tracing, the analogue of the reference's RUST_LOG
+// filtering (/root/reference/README.md:57-61, test.yml:23). Off by default;
+// enable with e.g.
+//   MADTPU_LOG=raft                 (one module)
+//   MADTPU_LOG=raft,shardkv         (several)
+//   MADTPU_LOG=all                  (everything)
+// Lines carry the VIRTUAL timestamp and the current node, so a trace of a
+// failing seed reads like the reference's madsim logger output.
+//   MT_LOG("raft", "term %llu: vote granted to %u", term, cand);
+namespace log_detail {
+inline bool module_enabled(const char* module) {
+  static const std::string filter = [] {
+    const char* e = std::getenv("MADTPU_LOG");
+    return std::string(e ? e : "");
+  }();
+  if (filter.empty()) return false;
+  if (filter == "all" || filter == "1") return true;
+  size_t pos = 0;
+  const std::string m(module);
+  while (pos < filter.size()) {
+    size_t comma = filter.find(',', pos);
+    if (comma == std::string::npos) comma = filter.size();
+    if (filter.compare(pos, comma - pos, m) == 0) return true;
+    pos = comma + 1;
+  }
+  return false;
+}
+void log_line(const char* module, const char* fmt, ...);  // defined in .cpp
+}  // namespace log_detail
+
+#define MT_LOG(module, ...)                                 \
+  do {                                                      \
+    if (::simcore::log_detail::module_enabled(module))      \
+      ::simcore::log_detail::log_line(module, __VA_ARGS__); \
+  } while (0)
+
 class Sim;
 
 // ------------------------------------------------------------------ Task<T>
