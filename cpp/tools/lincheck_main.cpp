@@ -1,0 +1,63 @@
+// madtpu_lincheck — run the Wing-Gong KV linearizability checker
+// (cpp/kvraft/linearize.h) over a history file. The KV end of the TPU<->C++
+// differential bridge: the batched fuzzer's reads-linearizability oracle
+// (madraft_tpu/tpusim/kv.py) reports a violating cluster; the Python side
+// exports its op history (madraft_tpu/bridge.py extract_kv_history) and this
+// tool must agree on (non-)linearizability. The reference leaves these
+// checks commented out (/root/reference/src/kvraft/tests.rs:386-390).
+//
+// History format (one op per line, '#' comments):
+//   op <invoke> <ret> <get|put|append> <key> <value>
+// where <value> is the Get output or the Put/Append input (no spaces).
+// Output: one line "linearizable" or "NOT-linearizable"; exit 0 either way.
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../kvraft/linearize.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <history-file>\n", argv[0]);
+    return 2;
+  }
+  std::ifstream f(argv[1]);
+  if (!f) {
+    std::fprintf(stderr, "cannot open %s\n", argv[1]);
+    return 2;
+  }
+  std::vector<kvraft::HistOp> hist;
+  std::string line;
+  while (std::getline(f, line)) {  // unbounded line/value length
+    if (line.empty() || line[0] == '#') continue;
+    std::istringstream ss(line);
+    std::string tag, kind, key, value;
+    unsigned long long invoke, ret;
+    ss >> tag >> invoke >> ret >> kind >> key;
+    if (!ss || tag != "op") {
+      std::fprintf(stderr, "bad line: %s\n", line.c_str());
+      return 2;
+    }
+    ss >> value;  // may be absent: an empty Get output is legal
+    kvraft::HistOp h;
+    h.invoke = invoke;
+    h.ret = ret;
+    h.key = key;
+    if (kind == "get") {
+      h.kind = kvraft::Op::Kind::Get;
+      h.output = value;
+    } else if (kind == "put") {
+      h.kind = kvraft::Op::Kind::Put;
+      h.input = value;
+    } else {
+      h.kind = kvraft::Op::Kind::Append;
+      h.input = value;
+    }
+    hist.push_back(std::move(h));
+  }
+  bool ok = kvraft::check_linearizable_kv(hist);
+  std::printf(ok ? "linearizable\n" : "NOT-linearizable\n");
+  return 0;
+}

@@ -173,6 +173,124 @@ def replay_on_simcore(
         os.unlink(path)
 
 
+def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
+    """Re-run ONE KV-fuzz cluster and export its op history as HistOp lines
+    for the C++ Wing-Gong checker (cpp/tools/lincheck_main.cpp).
+
+    Value translation: the TPU oracle observes per-key applied-APPEND COUNTS;
+    the checker works on append-string states. Since every node applies the
+    same committed order, observing count k is exactly observing the
+    concatenation of the first k committed appends to that key (in shadow
+    order), so each Get's output becomes that prefix string and each Append's
+    input its unique token. Requires the run to stay within one shadow window
+    (committed entries <= log_cap) so the full order is recoverable.
+
+    Returns (lines, violations): the history file body and the cluster's
+    violation bitmask.
+    """
+    # local import: keep the raft-only bridge importable without the kv layer
+    from madraft_tpu.tpusim.kv import _APPEND, _GET, _unpack, init_kv_cluster, kv_step
+
+    ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
+
+    @jax.jit
+    def run(key):
+        def body(carry, _):
+            nxt = kv_step(cfg, kcfg, carry, key)
+            return nxt, (nxt.clerk_seq, nxt.clerk_out, nxt.clerk_kind,
+                         nxt.clerk_key, nxt.clerk_acked, nxt.clerk_last_obs)
+
+        final, trace = jax.lax.scan(
+            body, init_kv_cluster(cfg, kcfg, key), None, length=n_ticks
+        )
+        return final, trace
+
+    final, (seq_t, out_t, kind_t, key_t, acked_t, obs_t) = jax.block_until_ready(
+        run(ckey)
+    )
+    seq_t, out_t, kind_t = np.asarray(seq_t), np.asarray(out_t), np.asarray(kind_t)
+    key_t, acked_t, obs_t = np.asarray(key_t), np.asarray(acked_t), np.asarray(obs_t)
+
+    # committed append order per key, deduped, from the final shadow window
+    sh_val = np.asarray(final.raft.shadow_val)
+    sh_base = int(final.raft.shadow_base)
+    sh_len = int(final.raft.shadow_len)
+    assert sh_len - 0 <= sh_val.shape[0], "history outgrew the shadow window"
+    cap = sh_val.shape[0]
+    lane_abs = sh_base + ((np.arange(cap) - sh_base) % cap) + 1
+    order = np.argsort(lane_abs)
+    appends_by_key: dict[int, list[str]] = {}
+    seen = set()
+    for lane in order:
+        if not (0 < lane_abs[lane] <= sh_len):
+            continue
+        val = int(sh_val[lane])
+        c, s, k, kind = _unpack(kcfg, val)
+        if kind != _APPEND or val in seen:
+            continue
+        seen.add(val)
+        appends_by_key.setdefault(int(k), []).append(f"a{int(c)}.{int(s)};")
+
+    nc = kcfg.n_clients
+    lines = []
+    T = seq_t.shape[0]
+    for c in range(nc):
+        for s in range(1, int(seq_t[:, c].max()) + 1):
+            # first tick whose post-state shows seq s = the start tick (works
+            # even when the op completes within that same tick, when out_t is
+            # already False again — the bug_stale_read serve path)
+            started = np.nonzero(seq_t[:, c] == s)[0]
+            if started.size == 0:
+                continue
+            invoke = int(started[0]) + 1
+            done = np.nonzero(acked_t[:, c] >= s)[0]
+            ret_idx = int(done[0]) if done.size else None
+            kind = int(kind_t[started[0], c])
+            key = int(key_t[started[0], c])
+            if kind == _GET:
+                if ret_idx is None:
+                    continue  # no reply: unconstrained, drop
+                obs = int(obs_t[ret_idx, c])
+                if obs < 0:
+                    continue  # defensive: completed Get must carry its obs
+                prefix = "".join(appends_by_key.get(key, [])[:obs])
+                lines.append(
+                    f"op {invoke} {ret_idx + 1} get k{key} {prefix}"
+                )
+            else:
+                # a pending append may still have taken effect: close it at
+                # the horizon so the checker may linearize it anywhere after
+                # invoke (sound; dropping it could fault a correct history)
+                ret = (ret_idx + 1) if ret_idx is not None else (T + 1)
+                lines.append(
+                    f"op {invoke} {ret} append k{key} a{c}.{s};"
+                )
+    return lines, int(final.raft.violations)
+
+
+def check_history_on_simcore(
+    lines: list[str], binary: Optional[pathlib.Path] = None
+) -> bool:
+    """Run the C++ Wing-Gong checker on an exported history; True = linearizable."""
+    binary = pathlib.Path(binary or _REPO / "build" / "madtpu_lincheck")
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".txt", prefix="madtpu_hist_", delete=False
+    ) as f:
+        f.write("\n".join(lines) + "\n")
+        path = f.name
+    try:
+        proc = subprocess.run(
+            [str(binary), path], capture_output=True, text=True, timeout=300
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"lincheck failed rc={proc.returncode}: {proc.stderr[-2000:]}"
+            )
+        return "NOT-linearizable" not in proc.stdout
+    finally:
+        os.unlink(path)
+
+
 def classes_match(tpu_violations: int, cpp_report: dict) -> bool:
     """Did the C++ replay observe (at least) one of the TPU's violation classes?"""
     if tpu_violations & VIOLATION_DUAL_LEADER and cpp_report["dual_leader"]:

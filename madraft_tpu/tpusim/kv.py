@@ -110,6 +110,9 @@ class KvState(NamedTuple):
     truth_max_seq: jax.Array  # i32 [NC] highest seq seen in the shadow per client
     clerk_get_lo: jax.Array  # i32 [NC] truth_count[key] captured at invoke
     clerk_get_obs: jax.Array  # i32 [NC] observed count; -1 = no reply yet
+    clerk_last_obs: jax.Array  # i32 [NC] observation of the last COMPLETED Get
+    #                            (stable across the reset at the next start —
+    #                            what history exporters read; bridge.py)
     gets_done: jax.Array     # i32 [NC] completed Gets (workload metric)
     # --- per-node apply machines. The live set is volatile (crash resets to
     # the snapshot); the snap_* set is the persisted service snapshot at the
@@ -153,6 +156,7 @@ def init_kv_cluster(cfg: SimConfig, kcfg: KvConfig, key: jax.Array) -> KvState:
         truth_max_seq=jnp.zeros((nc,), I32),
         clerk_get_lo=jnp.zeros((nc,), I32),
         clerk_get_obs=jnp.full((nc,), -1, I32),
+        clerk_last_obs=jnp.full((nc,), -1, I32),
         gets_done=jnp.zeros((nc,), I32),
         applied=jnp.zeros((n,), I32),
         last_seq=jnp.zeros((n, nc), I32),
@@ -370,6 +374,7 @@ def kv_step(
     clerk_acked = jnp.where(newly_acked, ks.clerk_seq, ks.clerk_acked)
     clerk_out = ks.clerk_out & ~newly_acked
     gets_done = ks.gets_done + done_get.astype(I32)
+    clerk_last_obs = jnp.where(done_get, clerk_get_obs, ks.clerk_last_obs)
 
     # start fresh ops / retry pending ones
     kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 4)
@@ -419,8 +424,12 @@ def kv_step(
             ),
             axis=(1, 2),
         )  # [nc]: key_count[target_c, key_c]
+        # ~start: the serve "RPC" takes at least a tick, so an op never
+        # completes in its start tick — this also keeps completions of
+        # consecutive ops on distinct ticks, which the history exporter's
+        # per-tick clerk_last_obs snapshot relies on (bridge.py)
         served = (
-            retry
+            retry & ~start
             & (clerk_kind == _GET)
             & jnp.any(tgt_oh & s.alive[None, :], axis=1)
         )
@@ -437,6 +446,8 @@ def kv_step(
         clerk_out = clerk_out & ~served
         gets_done = gets_done + served.astype(I32)
         retry = retry & ~served
+        # record the served value so history exporters (bridge) can see it
+        clerk_last_obs = jnp.where(served, local_cnt, clerk_last_obs)
 
     violations = s.violations | viol
     first_violation_tick = jnp.where(
@@ -485,6 +496,7 @@ def kv_step(
         truth_max_seq=truth_max_seq,
         clerk_get_lo=clerk_get_lo,
         clerk_get_obs=clerk_get_obs,
+        clerk_last_obs=clerk_last_obs,
         gets_done=gets_done,
         applied=applied,
         last_seq=last_seq,

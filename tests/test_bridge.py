@@ -23,14 +23,14 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 BUILD = ROOT / "build"
 
 
-def _ensure_replay_binary() -> pathlib.Path:
-    binary = BUILD / "madtpu_replay"
+def _ensure_binary(target: str) -> pathlib.Path:
+    binary = BUILD / target
     srcs = list((ROOT / "cpp").rglob("*.cpp")) + list((ROOT / "cpp").rglob("*.h"))
     newest = max(p.stat().st_mtime for p in srcs)
     if not binary.exists() or binary.stat().st_mtime < newest:
         for cmd in (
             ["cmake", "-S", str(ROOT / "cpp"), "-B", str(BUILD), "-G", "Ninja"],
-            ["ninja", "-C", str(BUILD), "madtpu_replay"],
+            ["ninja", "-C", str(BUILD), target],
         ):
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:  # surface the compiler diagnostics
@@ -39,6 +39,10 @@ def _ensure_replay_binary() -> pathlib.Path:
                     f"{proc.stderr[-4000:]}"
                 )
     return binary
+
+
+def _ensure_replay_binary() -> pathlib.Path:
+    return _ensure_binary("madtpu_replay")
 
 
 BUGGY = SimConfig(
@@ -77,6 +81,50 @@ def test_bridge_replays_violation_class():
         f"C++ replay saw no matching violation class: tpu={sched.violations:#x} "
         f"cpp={cpp}"
     )
+
+
+def test_kv_stale_read_cross_validated_by_wing_gong():
+    """VERDICT item: a stale read caught by the on-device interval oracle
+    must also fail the C++ Wing-Gong checker when its history is exported,
+    and a clean history must pass. (The interval oracle is slightly stricter
+    — it counts committed-but-unacked appends — so the bug run is asserted
+    over several clusters.)"""
+    from madraft_tpu.tpusim.kv import KvConfig, kv_fuzz
+
+    _ensure_lincheck_binary()
+    cfg = SimConfig(
+        n_nodes=5, p_client_cmd=0.0, compact_at_commit=False, log_cap=128,
+        compact_every=1 << 20,  # single shadow window for full-order export
+        loss_prob=0.1, p_crash=0.01, p_restart=0.2, max_dead=2,
+    )
+    kcfg = KvConfig(p_get=0.5, p_retry=0.6)
+    n_ticks = 200
+
+    # clean: every exported history is linearizable
+    rep = kv_fuzz(cfg, kcfg, seed=17, n_clusters=16, n_ticks=n_ticks)
+    assert rep.n_violating == 0
+    for cid in (0, 3):
+        lines, viol = bridge.extract_kv_history(cfg, kcfg, 17, cid, n_ticks)
+        assert viol == 0
+        assert len(lines) > 10
+        assert bridge.check_history_on_simcore(lines)
+
+    # bug: stale reads flagged on device must fail the Wing-Gong check too
+    bcfg = kcfg.replace(bug_stale_read=True)
+    rep = kv_fuzz(cfg, bcfg, seed=17, n_clusters=16, n_ticks=n_ticks)
+    bad = rep.violating_clusters()
+    assert bad.size > 0
+    flagged = 0
+    for cid in bad[:4]:
+        lines, viol = bridge.extract_kv_history(cfg, bcfg, 17, int(cid), n_ticks)
+        assert viol != 0
+        if not bridge.check_history_on_simcore(lines):
+            flagged += 1
+    assert flagged > 0, "no exported bug history failed the C++ checker"
+
+
+def _ensure_lincheck_binary() -> pathlib.Path:
+    return _ensure_binary("madtpu_lincheck")
 
 
 def test_bridge_clean_on_correct_quorum():
