@@ -45,6 +45,12 @@ _REPO = pathlib.Path(__file__).resolve().parent.parent
 DEFAULT_BINARY = _REPO / "build" / "madtpu_replay"
 
 
+def jnp_scalar(v: int):
+    import jax.numpy as jnp
+
+    return jnp.asarray(v, jnp.int32)
+
+
 @dataclasses.dataclass
 class Schedule:
     """One cluster's fault schedule plus the meta the C++ replayer needs."""
@@ -217,7 +223,9 @@ def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
     sh_len = int(final.raft.shadow_len)
     assert sh_len - 0 <= sh_val.shape[0], "history outgrew the shadow window"
     cap = sh_val.shape[0]
-    lane_abs = sh_base + ((np.arange(cap) - sh_base) % cap) + 1
+    from madraft_tpu.tpusim.step import _lane_abs  # one source for ring math
+
+    lane_abs = np.asarray(_lane_abs(jnp_scalar(sh_base), cap))
     order = np.argsort(lane_abs)
     appends_by_key: dict[int, list[str]] = {}
     seen = set()

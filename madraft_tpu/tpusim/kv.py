@@ -58,7 +58,7 @@ VIOLATION_STALE_READ = 32    # a Get observed a state outside its invoke..return
 _SEQ_LIM = 1 << 15  # packing limit: seq fits 15 bits
 _APPEND, _GET = 0, 1  # op kinds (the reference's Op::{Append,Get}, msg.rs:3-8)
 
-# PRNG site ids, disjoint from step.py's 0..7.
+# PRNG site ids, disjoint from step.py's _S_STEP_BLOCK (0).
 _S_CLERK_START, _S_CLERK_TARGET, _S_CLERK_RETRY, _S_CLERK_KEY = 8, 9, 10, 11
 _S_CLERK_KIND = 14
 

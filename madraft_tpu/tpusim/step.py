@@ -60,27 +60,62 @@ from madraft_tpu.tpusim.config import (
 )
 from madraft_tpu.tpusim.state import ClusterState, I32
 
-# PRNG site ids (fold_in constants) — one stream per independent decision site.
-_S_FAULT, _S_RVREQ, _S_AEREQ, _S_TIMER, _S_CLIENT, _S_HB, _S_GRANT, _S_AERESET = (
-    0, 1, 2, 3, 4, 5, 6, 7,
-)
-_S_SNREQ = 12
-_S_SNRESET = 13
-
 _BIG = 1 << 30  # sentinel above any absolute log index
 
-
-def _timeout_draw(cfg: SimConfig, key: jax.Array, shape) -> jax.Array:
-    return jax.random.randint(
-        key, shape, cfg.election_timeout_min, cfg.election_timeout_max + 1, dtype=I32
-    )
+# Raft-tick PRNG block id (kv.py/shardkv.py fold their own disjoint ids).
+_S_STEP_BLOCK = 0
 
 
-def _net_draws(cfg: SimConfig, key: jax.Array, shape):
+class _DrawBlock:
+    """All of a tick's randomness from ONE threefry call.
+
+    Per-site `fold_in`+`split`+draw calls have a fixed per-call cost that
+    dominated ~15% of the tick at 16k-cluster batches (measured: dropping a
+    single redundant [n,n] draw pair was worth +7%). Instead, one
+    `jax.random.bits` of the tick's full u32 budget is sliced STATICALLY in a
+    fixed order — same determinism contract (a pure function of the key),
+    one PRNG invocation.
+
+    randint uses modulo (negligible bias for the tiny spans here; the
+    election-timeout span is a power of two, so it is exact).
+    """
+
+    def __init__(self, key: jax.Array, total: int):
+        self.bits = jax.random.bits(key, (total,))  # uint32
+        self.off = 0
+
+    def _take(self, shape):
+        size = 1
+        for d in shape:
+            size *= d
+        out = self.bits[self.off:self.off + size].reshape(shape)
+        self.off += size
+        return out
+
+    def bern(self, p: float, shape):
+        return self._take(shape) < jnp.uint32(min(max(p, 0.0), 1.0) * 4294967295.0)
+
+    def randint(self, lo: int, hi: int, shape):  # [lo, hi)
+        return (lo + (self._take(shape) % jnp.uint32(hi - lo))).astype(I32)
+
+    def uniform(self, shape):
+        return self._take(shape).astype(jnp.float32) * jnp.float32(2.0 ** -32)
+
+
+def _block_total(n: int) -> int:
+    # faults 4n+1, three timer resets 3n, rv/ae response nets 4n, election
+    # timers n, client n, three [n,n] send nets with (delay, lost) each
+    return 13 * n + 1 + 6 * n * n
+
+
+def _timeout_draw(cfg: SimConfig, blk: "_DrawBlock", shape) -> jax.Array:
+    return blk.randint(cfg.election_timeout_min, cfg.election_timeout_max + 1, shape)
+
+
+def _net_draws(cfg: SimConfig, blk: "_DrawBlock", shape):
     """(delay, lost) draws for a batch of sends."""
-    kd, kl = jax.random.split(key)
-    delay = jax.random.randint(kd, shape, cfg.delay_min, cfg.delay_max + 1, dtype=I32)
-    lost = jax.random.bernoulli(kl, cfg.loss_prob, shape)
+    delay = blk.randint(cfg.delay_min, cfg.delay_max + 1, shape)
+    lost = blk.bern(cfg.loss_prob, shape)
     return delay, lost
 
 
@@ -120,13 +155,13 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     n, cap, ae_max = cfg.n_nodes, cfg.log_cap, cfg.ae_max
     t = s.tick + 1  # messages sent at tick t-1 with delay 1 arrive now
     key = jax.random.fold_in(cluster_key, t)
+    blk = _DrawBlock(jax.random.fold_in(key, _S_STEP_BLOCK), _block_total(n))
     me = jnp.arange(n, dtype=I32)
     eye = jnp.eye(n, dtype=jnp.bool_)
 
     # ------------------------------------------------------------------ faults
-    kf = jax.random.split(jax.random.fold_in(key, _S_FAULT), 5)
-    restart = (~s.alive) & jax.random.bernoulli(kf[0], cfg.p_restart, (n,))
-    crash_draw = s.alive & jax.random.bernoulli(kf[1], cfg.p_crash, (n,))
+    restart = (~s.alive) & blk.bern(cfg.p_restart, (n,))
+    crash_draw = s.alive & blk.bern(cfg.p_crash, (n,))
     # Keep a quorum-capable cluster: at most max_dead simultaneously-dead nodes.
     dead_after_restart = jnp.sum((~s.alive) & (~restart))
     budget = jnp.asarray(cfg.max_dead, I32) - dead_after_restart
@@ -137,7 +172,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     # the volatile set resets — raft.rs:194-211 restore(), tester.rs:284-327).
     # The snapshot covers 1..base, so commit restarts at base, not 0.
     role = jnp.where(restart, FOLLOWER, s.role)
-    timer = jnp.where(restart, _timeout_draw(cfg, kf[2], (n,)), s.timer)
+    timer = jnp.where(restart, _timeout_draw(cfg, blk, (n,)), s.timer)
     hb = jnp.where(restart, 0, s.hb)
     commit = jnp.where(restart, s.base, s.commit)
     compact_floor = jnp.where(restart, s.base, s.compact_floor)
@@ -147,8 +182,8 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
 
     # Partition schedule: random 2-coloring / heal (connect2/disconnect2 masks,
     # /root/reference/src/kvraft/tester.rs:88-124).
-    u_part = jax.random.uniform(kf[3], ())
-    colors = jax.random.bernoulli(kf[4], 0.5, (n,))
+    u_part = blk.uniform(())
+    colors = blk.bern(0.5, (n,))
     part_adj = colors[:, None] == colors[None, :]
     do_part = u_part < cfg.p_repartition
     do_heal = (~do_part) & (u_part < cfg.p_repartition + cfg.p_heal)
@@ -190,7 +225,6 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     # snapshot at delivery; a dead sender = a lost message (state.py
     # rationale). The message's LEADER term deposes stale leaders exactly
     # like AE/RV traffic, and only the current term's leader may install.
-    k_snreset = jax.random.fold_in(key, _S_SNRESET)
     pick, defer, due = pick_one(s.sn_req_t, extra_ok=alive[None, :])
     # clear every slot due this tick (processed, dropped, or dst dead)
     sn_req_t = jnp.where((s.sn_req_t == t) & ~defer, 0, s.sn_req_t)
@@ -205,7 +239,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     acc = got & (mterm == term)
     role = jnp.where(acc & (role == CANDIDATE), FOLLOWER, role)
     # current-leader contact resets the election timer
-    timer = jnp.where(acc, _timeout_draw(cfg, k_snreset, (n,)), timer)
+    timer = jnp.where(acc, _timeout_draw(cfg, blk, (n,)), timer)
     slen = picked(pick, jnp.broadcast_to(s.base[None, :], (n, n)))
     sterm_snap = picked(pick, jnp.broadcast_to(s.snap_term[None, :], (n, n)))
     # cond_install (raft.rs:153): ignore a snapshot behind our commit.
@@ -232,7 +266,6 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     abs_arr = _lane_abs(base, cap)  # [n, cap]
 
     # ----------------------------------------------------- deliver: RV requests
-    k_grant = jax.random.fold_in(key, _S_GRANT)
     pick, defer, due = pick_one(s.rv_req_t)
     rv_req_t = jnp.where((s.rv_req_t == t) & ~defer, 0, s.rv_req_t)
     rv_req_t = jnp.where(defer, t + 1, rv_req_t)
@@ -254,8 +287,8 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         (voted_for == -1) | (voted_for == src_id)
     ) & log_ok
     voted_for = jnp.where(grant, src_id, voted_for)
-    timer = jnp.where(grant, _timeout_draw(cfg, k_grant, (n,)), timer)
-    delay, lost = _net_draws(cfg, jax.random.fold_in(key, _S_RVREQ), (n,))
+    timer = jnp.where(grant, _timeout_draw(cfg, blk, (n,)), timer)
+    delay, lost = _net_draws(cfg, blk, (n,))
     send = got & ~lost  # per voter (one response per tick)
     # response slot [candidate, voter] <- the picked (voter, candidate) pair
     resp = pick.T & send[None, :]
@@ -264,7 +297,6 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     rv_rsp_granted = jnp.where(resp, grant[None, :], rv_rsp_granted)
 
     # ----------------------------------------------------- deliver: AE requests
-    k_aereset = jax.random.fold_in(key, _S_AERESET)
     lane = jnp.arange(cap, dtype=I32)[None, :]
     pick, defer, due = pick_one(s.ae_req_t)
     ae_req_t = jnp.where((s.ae_req_t == t) & ~defer, 0, s.ae_req_t)
@@ -278,7 +310,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     voted_for = jnp.where(higher, -1, voted_for)
     acc = got & (mterm == term)  # AppendEntries from the current-term leader
     role = jnp.where(acc & (role == CANDIDATE), FOLLOWER, role)
-    timer = jnp.where(acc, _timeout_draw(cfg, k_aereset, (n,)), timer)
+    timer = jnp.where(acc, _timeout_draw(cfg, blk, (n,)), timer)
     prev = picked(pick, s.ae_req_prev)
     # prev at-or-below our snapshot boundary is committed => matches by
     # definition; otherwise the terms must agree (log-matching check).
@@ -339,7 +371,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         jnp.maximum(jnp.where(has_cand, first_abs - 1, base), base),
     )
     rsp_match = jnp.where(success, batch_end, hint)
-    delay, lost = _net_draws(cfg, jax.random.fold_in(key, _S_AEREQ), (n,))
+    delay, lost = _net_draws(cfg, blk, (n,))
     send = got & ~lost  # per follower (one response per tick)
     resp = pick.T & send[None, :]  # slot [leader, follower]
     ae_rsp_t = jnp.where(resp, (t + delay)[None, :], ae_rsp_t)
@@ -402,7 +434,6 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     hb = jnp.where(win, 0, hb)  # announce leadership with an immediate heartbeat
 
     # ------------------------------------------------- timers: election timeout
-    kt = jax.random.split(jax.random.fold_in(key, _S_TIMER), 3)
     running = alive & (role != LEADER)
     timer = jnp.where(running, timer - 1, timer)
     fired = running & (timer <= 0)
@@ -410,12 +441,12 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     role = jnp.where(fired, CANDIDATE, role)
     voted_for = jnp.where(fired, me, voted_for)
     votes = jnp.where(fired[:, None], eye, votes)
-    timer = jnp.where(fired, _timeout_draw(cfg, kt[0], (n,)), timer)
+    timer = jnp.where(fired, _timeout_draw(cfg, blk, (n,)), timer)
 
     llt = jnp.where(
         log_len > base, _row_gather(log_term, _slot(log_len, cap), cap), snap_term
     )
-    delay, lost = _net_draws(cfg, kt[1], (n, n))
+    delay, lost = _net_draws(cfg, blk, (n, n))
     send_rv = fired[None, :] & ~eye & adj.T & ~lost  # [dst, src], link src->dst
     rv_req_t = jnp.where(send_rv, t + delay, rv_req_t)
     rv_req_term = jnp.where(send_rv, term[None, :], s.rv_req_term)
@@ -424,11 +455,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
 
     # --------------------------------------- client command injection at leaders
     lead = alive & (role == LEADER)
-    inject = (
-        lead
-        & jax.random.bernoulli(jax.random.fold_in(key, _S_CLIENT), cfg.p_client_cmd, (n,))
-        & (log_len - base < cap)
-    )
+    inject = lead & blk.bern(cfg.p_client_cmd, (n,)) & (log_len - base < cap)
     cmd_val = s.next_cmd * n + me + 1  # unique within the cluster, never 0
     inj_hit = inject[:, None] & (lane == _slot(log_len + 1, cap)[:, None])
     log_term = jnp.where(inj_hit, term[:, None], log_term)
@@ -458,7 +485,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         jnp.sum(jnp.where(oh_p, log_term[None, :, :], 0), axis=-1),
         snap_term[None, :],
     )
-    delay, lost = _net_draws(cfg, jax.random.fold_in(key, _S_HB), (n, n))
+    delay, lost = _net_draws(cfg, blk, (n, n))
     # Eager replication: a leader with unsent entries for a peer fires an AE
     # at once — the reference replicates on start() immediately
     # (raft.rs:266-293 fan-out); the heartbeat cadence governs only the idle
@@ -475,7 +502,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     ae_req_commit = jnp.where(send_ae, commit[None, :], s.ae_req_commit)
     ae_req_ent_term = jnp.where(send_ae[:, :, None], ent_t, s.ae_req_ent_term)
     ae_req_ent_val = jnp.where(send_ae[:, :, None], ent_v, s.ae_req_ent_val)
-    delay_sn, lost_sn = _net_draws(cfg, jax.random.fold_in(key, _S_SNREQ), (n, n))
+    delay_sn, lost_sn = _net_draws(cfg, blk, (n, n))
     send_sn = fire_hb[None, :] & ~eye & adj.T & ~lost_sn & need_snap
     sn_req_t = jnp.where(send_sn, t + delay_sn, sn_req_t)
     sn_req_term = jnp.where(send_sn, term[None, :], s.sn_req_term)
