@@ -30,6 +30,7 @@ import tempfile
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from madraft_tpu.tpusim.config import (
@@ -39,16 +40,10 @@ from madraft_tpu.tpusim.config import (
     VIOLATION_LOG_MATCHING,
 )
 from madraft_tpu.tpusim.state import init_cluster
-from madraft_tpu.tpusim.step import step_cluster
+from madraft_tpu.tpusim.step import _lane_abs, step_cluster
 
 _REPO = pathlib.Path(__file__).resolve().parent.parent
 DEFAULT_BINARY = _REPO / "build" / "madtpu_replay"
-
-
-def jnp_scalar(v: int):
-    import jax.numpy as jnp
-
-    return jnp.asarray(v, jnp.int32)
 
 
 @dataclasses.dataclass
@@ -223,9 +218,8 @@ def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
     sh_len = int(final.raft.shadow_len)
     assert sh_len - 0 <= sh_val.shape[0], "history outgrew the shadow window"
     cap = sh_val.shape[0]
-    from madraft_tpu.tpusim.step import _lane_abs  # one source for ring math
-
-    lane_abs = np.asarray(_lane_abs(jnp_scalar(sh_base), cap))
+    # one source of truth for the ring math (step.py)
+    lane_abs = np.asarray(_lane_abs(jnp.asarray(sh_base, jnp.int32), cap))
     order = np.argsort(lane_abs)
     appends_by_key: dict[int, list[str]] = {}
     seen = set()

@@ -77,10 +77,12 @@ class SimConfig:
 
 
 # Violation bitmask values (oracle reductions; raft oracles live in step.py,
-# service-layer oracles extend these in kv.py).
+# service-layer oracles extend these in kv.py / shardkv.py with bits 8..256).
 VIOLATION_DUAL_LEADER = 1      # two live leaders share a term (election safety)
 VIOLATION_LOG_MATCHING = 2     # same (index, term) but diverging entries/prefix
 VIOLATION_COMMIT_SHADOW = 4    # a committed entry changed or was lost (durability)
+VIOLATION_PREFIX_DIVERGE = 512  # equal snapshot boundaries, different compacted
+#                                 prefix hashes (durability beyond the window)
 
 # Role encoding.
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2

@@ -78,7 +78,7 @@ _APPEND, _CONFIG, _INSTALL, _DELETE = 0, 1, 2, 3
 # Shard phases.
 ABSENT, OWNED, PULLING, FROZEN = 0, 1, 2, 3
 
-# PRNG site ids (disjoint from step.py 0..7 and kv.py 8..14).
+# PRNG site ids (disjoint from step.py _S_STEP_BLOCK=0 and kv.py 8..14).
 _S_GROUP = 100       # + g: per-group raft stream
 _S_POLL = 16
 _S_PULL = 17

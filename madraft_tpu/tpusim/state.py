@@ -56,6 +56,11 @@ class ClusterState(NamedTuple):
     log_len: jax.Array         # i32 [N] absolute length (highest index present)
     base: jax.Array            # i32 [N] snapshot boundary (persistent)
     snap_term: jax.Array       # i32 [N] term at index `base` (persistent)
+    prefix_hash: jax.Array     # i32 [N] order-free hash of entries 1..base
+    #                            (persistent; folded at compaction, adopted at
+    #                            install-snapshot) — lets the durability oracle
+    #                            see divergence on entries older than the
+    #                            window (step.py prefix-divergence check)
     commit: jax.Array          # i32 [N] committed count, absolute (volatile)
     compact_floor: jax.Array   # i32 [N] service-layer cap on the compaction
     #                            boundary (= its apply cursor); unused when
@@ -110,6 +115,8 @@ class ClusterState(NamedTuple):
     shadow_val: jax.Array      # i32 [CAP]
     shadow_base: jax.Array     # i32 scalar
     shadow_len: jax.Array      # i32 scalar
+    shadow_prefix_hash: jax.Array  # i32 scalar: hash of entries slid out of
+    #                                the shadow window (same fold as nodes)
     violations: jax.Array      # i32 scalar sticky bitmask
     first_violation_tick: jax.Array  # i32 scalar, -1 = none
     first_leader_tick: jax.Array     # i32 scalar, -1 = none (liveness metric)
@@ -138,6 +145,7 @@ def init_cluster(cfg: SimConfig, key: jax.Array) -> ClusterState:
         log_len=zn,
         base=zn,
         snap_term=zn,
+        prefix_hash=zn,
         commit=zn,
         compact_floor=zn,
         votes=jnp.zeros((n, n), BOOL),
@@ -161,6 +169,7 @@ def init_cluster(cfg: SimConfig, key: jax.Array) -> ClusterState:
         shadow_val=jnp.zeros((cap,), I32),
         shadow_base=jnp.asarray(0, I32),
         shadow_len=jnp.asarray(0, I32),
+        shadow_prefix_hash=jnp.asarray(0, I32),
         violations=jnp.asarray(0, I32),
         first_violation_tick=jnp.asarray(-1, I32),
         first_leader_tick=jnp.asarray(-1, I32),

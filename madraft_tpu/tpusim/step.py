@@ -57,6 +57,7 @@ from madraft_tpu.tpusim.config import (
     VIOLATION_COMMIT_SHADOW,
     VIOLATION_DUAL_LEADER,
     VIOLATION_LOG_MATCHING,
+    VIOLATION_PREFIX_DIVERGE,
 )
 from madraft_tpu.tpusim.state import ClusterState, I32
 
@@ -143,6 +144,15 @@ def _row_gather(arr: jax.Array, pos: jax.Array, cap: int) -> jax.Array:
     return jnp.sum(jnp.where(oh, arr, 0), axis=-1)
 
 
+def _entry_mix(term: jax.Array, val: jax.Array, abs_idx: jax.Array) -> jax.Array:
+    """Position-sensitive entry hash whose XOR-fold is order-free, so a batch
+    of entries crossing a compaction boundary folds in one vectorized pass
+    (no sequential loop). Any two histories differing in a compacted entry's
+    (term, value, index) diverge with overwhelming probability."""
+    h = (val ^ (abs_idx * jnp.int32(-1640531527))) * jnp.int32(-2048144789)
+    return h ^ (term * jnp.int32(-1028477387))
+
+
 def _term_at(log_term, snap_term, base, abs_idx, cap):
     """Term of absolute (1-based) index abs_idx per node; snap_term at the
     boundary itself. Callers mask positions outside (base, log_len]."""
@@ -191,7 +201,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
 
     term, voted_for = s.term, s.voted_for
     log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
-    base, snap_term = s.base, s.snap_term
+    base, snap_term, prefix_hash = s.base, s.snap_term, s.prefix_hash
     rv_rsp_t, rv_rsp_term, rv_rsp_granted = s.rv_rsp_t, s.rv_rsp_term, s.rv_rsp_granted
     ae_rsp_t, ae_rsp_term = s.ae_rsp_t, s.ae_rsp_term
     ae_rsp_success, ae_rsp_match = s.ae_rsp_success, s.ae_rsp_match
@@ -254,6 +264,12 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     log_len = jnp.where(inst, jnp.where(keep, log_len, slen), log_len)
     base = jnp.where(inst, slen, base)
     snap_term = jnp.where(inst, sterm_snap, snap_term)
+    # adopt the sender's compacted-prefix hash with its boundary (atomic pair)
+    prefix_hash = jnp.where(
+        inst,
+        picked(pick, jnp.broadcast_to(s.prefix_hash[None, :], (n, n))),
+        prefix_hash,
+    )
     commit = jnp.where(inst, jnp.maximum(commit, slen), commit)
     compact_floor = jnp.where(inst, slen, compact_floor)
     src_id = picked(pick, jnp.broadcast_to(me[None, :], (n, n)))
@@ -555,6 +571,14 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     shadow_len = s.shadow_len
     need = jnp.max(jnp.where(alive, commit, 0))
     shadow_base = jnp.maximum(s.shadow_base, need - cap)
+    # fold entries sliding out of the shadow window into its prefix hash
+    # (indices (old base, new base]; new base never outruns the recorded
+    # length because a per-tick commit jump is bounded by the log window)
+    old_abs = _lane_abs(s.shadow_base, cap)
+    slide = old_abs <= jnp.minimum(shadow_base, s.shadow_len)
+    shadow_prefix_hash = s.shadow_prefix_hash ^ jnp.bitwise_xor.reduce(
+        jnp.where(slide, _entry_mix(s.shadow_term, s.shadow_val, old_abs), 0)
+    )
     sh_abs = _lane_abs(shadow_base, cap)  # [cap]
     for i in range(n):
         c = commit[i]
@@ -566,6 +590,24 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         shadow_term = jnp.where(new, log_term[i], shadow_term)
         shadow_val = jnp.where(new, log_val[i], shadow_val)
         shadow_len = jnp.maximum(shadow_len, c)
+
+    # Prefix durability (the long-range extension of the shadow oracle, which
+    # only sees the last `cap` committed entries; the round-1 advisory gap):
+    # equal snapshot boundaries must mean equal compacted prefixes — across
+    # nodes, and against the shadow's own slid-out fold.
+    same_base = (
+        (base[:, None] == base[None, :]) & (base[:, None] > 0) & ~eye
+        & alive[:, None] & alive[None, :]
+    )
+    viol |= jnp.where(
+        jnp.any(same_base & (prefix_hash[:, None] != prefix_hash[None, :])),
+        VIOLATION_PREFIX_DIVERGE, 0,
+    )
+    vs_shadow = (
+        alive & (base == s.shadow_base) & (base > 0)
+        & (prefix_hash != s.shadow_prefix_hash)
+    )
+    viol |= jnp.where(jnp.any(vs_shadow), VIOLATION_PREFIX_DIVERGE, 0)
 
     violations = s.violations | viol
     first_violation_tick = jnp.where(
@@ -585,6 +627,11 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     boundary = commit if cfg.compact_at_commit else jnp.minimum(compact_floor, commit)
     do_compact = alive & (boundary - base >= cfg.compact_every)
     new_snap_term = _term_at(log_term, snap_term, base, boundary, cap)
+    # fold the entries crossing the boundary into the node's prefix hash
+    out_lanes = do_compact[:, None] & (abs_arr <= boundary[:, None])
+    prefix_hash = prefix_hash ^ jnp.bitwise_xor.reduce(
+        jnp.where(out_lanes, _entry_mix(log_term, log_val, abs_arr), 0), axis=1
+    )
     snap_term = jnp.where(do_compact, new_snap_term, snap_term)
     base = jnp.where(do_compact, boundary, base)
 
@@ -592,7 +639,8 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         tick=t,
         term=term, voted_for=voted_for, role=role, timer=timer, hb=hb, alive=alive,
         log_term=log_term, log_val=log_val, log_len=log_len,
-        base=base, snap_term=snap_term, commit=commit, compact_floor=compact_floor,
+        base=base, snap_term=snap_term, prefix_hash=prefix_hash,
+        commit=commit, compact_floor=compact_floor,
         votes=votes, next_idx=next_idx, match_idx=match_idx, adj=adj,
         rv_req_t=rv_req_t, rv_req_term=rv_req_term,
         rv_req_lli=rv_req_lli, rv_req_llt=rv_req_llt,
@@ -610,6 +658,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         next_cmd=next_cmd,
         shadow_term=shadow_term, shadow_val=shadow_val,
         shadow_base=shadow_base, shadow_len=shadow_len,
+        shadow_prefix_hash=shadow_prefix_hash,
         violations=violations, first_violation_tick=first_violation_tick,
         first_leader_tick=first_leader_tick,
         msg_count=s.msg_count + delivered,

@@ -53,6 +53,24 @@ def test_kv_exactly_once_across_snapshots():
     assert rep.acked_ops.sum() > 64 * 8
 
 
+def test_prefix_durability_oracle():
+    """The commit shadow only covers the last log_cap committed entries; the
+    prefix-hash oracle extends durability checking past the window (the
+    round-1 advisory gap): equal snapshot boundaries must mean identical
+    compacted prefixes. Clean storms must stay silent through compaction,
+    restart, and install-snapshot; a broken quorum must trip it — divergent
+    committed prefixes eventually get compacted on both sides."""
+    from madraft_tpu.tpusim.config import VIOLATION_PREFIX_DIVERGE
+
+    bug = RAFT.replace(majority_override=2, p_crash=0.0, max_dead=0)
+    rep = fuzz(bug, seed=5, n_clusters=64, n_ticks=640)
+    assert rep.n_violating > 0
+    hits = (rep.violations & VIOLATION_PREFIX_DIVERGE) != 0
+    assert hits.sum() > 10, f"prefix oracle fired in only {hits.sum()} clusters"
+    # clean-run silence is covered by test_long_history_past_window (same
+    # config, no override) — any false positive would fail it
+
+
 def test_compaction_determinism():
     """Same seed => identical outcome with compaction in the loop."""
     r1 = fuzz(RAFT, seed=77, n_clusters=48, n_ticks=384)
