@@ -1,4 +1,4 @@
-"""Static simulation configuration.
+"""Simulation configuration: static shape knobs + dynamic runtime knobs.
 
 Mirrors the knobs of the reference runtime (madsim 0.1.1) and its testers, quantized
 onto a tick grid: the reference draws election timeouts of 150..300ms
@@ -6,13 +6,27 @@ onto a tick grid: the reference draws election timeouts of 150..300ms
 loss in unreliable mode (/root/reference/src/raft/tester.rs:127-137). With the default
 ``ms_per_tick=10`` those become 15..30 tick timeouts and 1..3 tick delivery delays.
 
-Everything here is static (hashable) so a ``SimConfig`` can close over jitted step
-functions without retracing.
+Two kinds of knobs, split deliberately:
+
+- **Static** (shapes and loop bounds: ``n_nodes``, ``log_cap``, ``ae_max``) are
+  Python ints baked into the trace — they determine array shapes, so they must
+  be.
+- **Dynamic** (every probability, timeout span, cadence, quorum override) are
+  carried as traced scalars (``Knobs``) through the jit boundary. One compiled
+  XLA program therefore serves *any* fault intensity, *any* bug injection, and
+  — because the engine broadcasts knobs per cluster — a whole *sweep* of fault
+  parameters across the cluster batch in a single program. This is the
+  TPU-idiomatic inversion of the reference's compile-time test matrix: the
+  program is compiled once; the matrix is data.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,6 +88,63 @@ class SimConfig:
 
     def replace(self, **kw) -> "SimConfig":
         return dataclasses.replace(self, **kw)
+
+    def knobs(self) -> "Knobs":
+        """The dynamic knobs as traced-able scalars (see module docstring)."""
+        return Knobs(
+            loss_prob=jnp.float32(self.loss_prob),
+            p_crash=jnp.float32(self.p_crash),
+            p_restart=jnp.float32(self.p_restart),
+            p_repartition=jnp.float32(self.p_repartition),
+            p_heal=jnp.float32(self.p_heal),
+            p_client_cmd=jnp.float32(self.p_client_cmd),
+            eto_min=jnp.int32(self.election_timeout_min),
+            eto_max=jnp.int32(self.election_timeout_max),
+            delay_min=jnp.int32(self.delay_min),
+            delay_max=jnp.int32(self.delay_max),
+            heartbeat_ticks=jnp.int32(self.heartbeat_ticks),
+            compact_every=jnp.int32(self.compact_every),
+            max_dead=jnp.int32(self.max_dead),
+            majority=jnp.int32(self.majority),
+            compact_at_commit=jnp.bool_(self.compact_at_commit),
+        )
+
+    def static_key(self) -> "SimConfig":
+        """Canonical config carrying only the fields that shape the compiled
+        program (everything else rides in ``Knobs``). Two configs with equal
+        static_key share one XLA program."""
+        return SimConfig(
+            n_nodes=self.n_nodes, log_cap=self.log_cap, ae_max=self.ae_max
+        )
+
+
+class Knobs(NamedTuple):
+    """Dynamic simulation knobs, traced through jit (one leaf per field).
+
+    Scalars normally; the engine broadcasts them to a leading ``[clusters]``
+    axis so heterogeneous per-cluster fault schedules (parameter sweeps)
+    compile to the same program as the homogeneous case.
+    """
+
+    loss_prob: jax.Array
+    p_crash: jax.Array
+    p_restart: jax.Array
+    p_repartition: jax.Array
+    p_heal: jax.Array
+    p_client_cmd: jax.Array
+    eto_min: jax.Array
+    eto_max: jax.Array
+    delay_min: jax.Array
+    delay_max: jax.Array
+    heartbeat_ticks: jax.Array
+    compact_every: jax.Array
+    max_dead: jax.Array
+    majority: jax.Array
+    compact_at_commit: jax.Array
+
+    def broadcast(self, n_clusters: int) -> "Knobs":
+        """Per-cluster copies (leading axis) for vmap'ing over clusters."""
+        return Knobs(*(jnp.broadcast_to(x, (n_clusters,)) for x in self))
 
 
 # Violation bitmask values (oracle reductions; raft oracles live in step.py,

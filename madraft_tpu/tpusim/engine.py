@@ -46,25 +46,24 @@ def _cluster_keys(seed, n_clusters: int) -> jax.Array:
     return jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(n_clusters))
 
 
-def make_fuzz_fn(
-    cfg: SimConfig,
-    n_clusters: int,
-    n_ticks: int,
-    mesh: Optional[Mesh] = None,
-):
-    """Build a jitted fn(seed) -> final batched ClusterState.
+@functools.lru_cache(maxsize=None)
+def _fuzz_program(static_cfg: SimConfig, n_clusters: int, mesh: Optional[Mesh]):
+    """One compiled program per (static shape, batch, mesh).
 
-    With a mesh, the cluster axis of every state leaf is sharded over the mesh's
-    first axis (pure data parallelism; per-step work stays chip-local).
+    Everything else — probabilities, timeouts, quorum override, tick count —
+    is a runtime argument: the dynamic knobs ride in as a per-cluster `Knobs`
+    pytree and the tick count as a `fori_loop` bound. Two configs differing
+    only in dynamic knobs (or tick counts) share this program, which is what
+    keeps a cold test-suite run compile-light and lets one program sweep a
+    grid of fault intensities across the cluster batch.
     """
     constraint = None
     if mesh is not None:
-        axis = mesh.axis_names[0]
-        constraint = NamedSharding(mesh, P(axis))
+        constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
 
-    def run(seed) -> ClusterState:
+    def run(seed, kn, n_ticks) -> ClusterState:
         keys = _cluster_keys(seed, n_clusters)
-        states = jax.vmap(functools.partial(init_cluster, cfg))(keys)
+        states = jax.vmap(functools.partial(init_cluster, static_cfg))(keys, kn)
         if constraint is not None:
             states = jax.lax.with_sharding_constraint(
                 states, jax.tree.map(lambda _: constraint, states)
@@ -73,14 +72,47 @@ def make_fuzz_fn(
         else:
             keys2 = keys
 
-        def body(carry, _):
-            nxt = jax.vmap(functools.partial(step_cluster, cfg))(carry, keys2)
-            return nxt, None
+        def body(_, carry):
+            return jax.vmap(functools.partial(step_cluster, static_cfg))(
+                carry, keys2, kn
+            )
 
-        final, _ = jax.lax.scan(body, states, None, length=n_ticks)
-        return final
+        return jax.lax.fori_loop(0, n_ticks, body, states)
 
     return jax.jit(run)
+
+
+def make_fuzz_fn(
+    cfg: SimConfig,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+):
+    """Build fn(seed) -> final batched ClusterState.
+
+    With a mesh, the cluster axis of every state leaf is sharded over the mesh's
+    first axis (pure data parallelism; per-step work stays chip-local).
+    """
+    prog = _fuzz_program(cfg.static_key(), n_clusters, mesh)
+    kn = cfg.knobs().broadcast(n_clusters)
+    ticks = jnp.asarray(n_ticks, jnp.int32)
+    return lambda seed: prog(seed, kn, ticks)
+
+
+def make_sweep_fn(
+    cfg: SimConfig,
+    knobs,  # config.Knobs with leading [n_clusters] axes (heterogeneous)
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+):
+    """Like make_fuzz_fn, but each cluster runs its own dynamic knobs — a
+    fault-parameter sweep (e.g. loss x crash-rate grid) in ONE compiled
+    program, something the reference's compile-time test matrix cannot do."""
+    prog = _fuzz_program(cfg.static_key(), n_clusters, mesh)
+    kn = jax.tree.map(lambda x: jnp.broadcast_to(x, (n_clusters,)), knobs)
+    ticks = jnp.asarray(n_ticks, jnp.int32)
+    return lambda seed: prog(seed, kn, ticks)
 
 
 def report(final: ClusterState) -> FuzzReport:
@@ -112,16 +144,26 @@ def fuzz(
     return report(final)
 
 
+@functools.lru_cache(maxsize=None)
+def _replay_program(static_cfg: SimConfig):
+    def run(cluster_id, kn, n_ticks, seed):
+        ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
+        state = init_cluster(static_cfg, ckey, kn)
+
+        def body(_, carry):
+            return step_cluster(static_cfg, carry, ckey, kn)
+
+        return jax.lax.fori_loop(0, n_ticks, body, state)
+
+    return jax.jit(run)
+
+
 def replay_cluster(
     cfg: SimConfig, seed: int, cluster_id: int, n_ticks: int
 ) -> ClusterState:
     """Re-run a single cluster (e.g. a violating one) for inspection/replay."""
-    base = jax.random.PRNGKey(seed)
-    ckey = jax.random.fold_in(base, cluster_id)
-    state = init_cluster(cfg, ckey)
-
-    def body(carry, _):
-        return step_cluster(cfg, carry, ckey), None
-
-    final, _ = jax.lax.scan(body, state, None, length=n_ticks)
-    return jax.block_until_ready(final)
+    prog = _replay_program(cfg.static_key())
+    return jax.block_until_ready(
+        prog(jnp.asarray(cluster_id, jnp.int32), cfg.knobs(),
+             jnp.asarray(n_ticks, jnp.int32), jnp.asarray(seed, jnp.uint32))
+    )

@@ -65,7 +65,10 @@ _S_CLERK_KIND = 14
 
 @dataclasses.dataclass(frozen=True)
 class KvConfig:
-    """Static knobs of the KV fuzzing layer."""
+    """Knobs of the KV fuzzing layer. ``n_clients``/``n_keys``/``apply_max``
+    shape the program; everything else (probabilities AND the bug injections)
+    is dynamic — carried as traced scalars so every bug mode shares one
+    compiled program with the correct service."""
 
     n_clients: int = 4
     n_keys: int = 4
@@ -83,6 +86,31 @@ class KvConfig:
 
     def replace(self, **kw) -> "KvConfig":
         return dataclasses.replace(self, **kw)
+
+    def knobs(self) -> "KvKnobs":
+        return KvKnobs(
+            p_op=jnp.float32(self.p_op),
+            p_get=jnp.float32(self.p_get),
+            p_retry=jnp.float32(self.p_retry),
+            bug_skip_dedup=jnp.bool_(self.bug_skip_dedup),
+            bug_apply_uncommitted=jnp.bool_(self.bug_apply_uncommitted),
+            bug_stale_read=jnp.bool_(self.bug_stale_read),
+        )
+
+    def static_key(self) -> "KvConfig":
+        return KvConfig(n_clients=self.n_clients, n_keys=self.n_keys,
+                        apply_max=self.apply_max)
+
+
+class KvKnobs(NamedTuple):
+    """Dynamic KV-layer knobs (see KvConfig)."""
+
+    p_op: jax.Array
+    p_get: jax.Array
+    p_retry: jax.Array
+    bug_skip_dedup: jax.Array
+    bug_apply_uncommitted: jax.Array
+    bug_stale_read: jax.Array
 
 
 class KvState(NamedTuple):
@@ -130,6 +158,14 @@ class KvState(NamedTuple):
     snap_key_count: jax.Array    # i32 [N, NK] (persistent)
 
 
+def _check_kv_cfg(cfg: SimConfig) -> None:
+    assert cfg.p_client_cmd == 0.0, "KV layer owns command injection"
+    assert not cfg.compact_at_commit, (
+        "KV fuzzing needs cfg.compact_at_commit=False: the compaction "
+        "boundary must follow the apply cursor, not the commit index"
+    )
+
+
 def _pack(cfg: KvConfig, client, seq, key, kind):
     return (((client * _SEQ_LIM + seq) * cfg.n_keys + key) * 2 + kind) + 1
 
@@ -143,10 +179,12 @@ def _unpack(cfg: KvConfig, val):
     return cs // _SEQ_LIM, cs % _SEQ_LIM, key, kind  # client, seq, key, kind
 
 
-def init_kv_cluster(cfg: SimConfig, kcfg: KvConfig, key: jax.Array) -> KvState:
+def init_kv_cluster(
+    cfg: SimConfig, kcfg: KvConfig, key: jax.Array, kn=None
+) -> KvState:
     n, nc, nk = cfg.n_nodes, kcfg.n_clients, kcfg.n_keys
     return KvState(
-        raft=init_cluster(cfg, key),
+        raft=init_cluster(cfg, key, kn),
         clerk_seq=jnp.zeros((nc,), I32),
         clerk_out=jnp.zeros((nc,), jnp.bool_),
         clerk_key=jnp.zeros((nc,), I32),
@@ -171,19 +209,20 @@ def init_kv_cluster(cfg: SimConfig, kcfg: KvConfig, key: jax.Array) -> KvState:
 
 
 def kv_step(
-    cfg: SimConfig, kcfg: KvConfig, ks: KvState, cluster_key: jax.Array
+    cfg: SimConfig, kcfg: KvConfig, ks: KvState, cluster_key: jax.Array,
+    kn=None, kkn=None,
 ) -> KvState:
     """One lockstep tick: raft tick, then apply machines, oracles, clerks."""
-    assert cfg.p_client_cmd == 0.0, "KV layer owns command injection"
-    assert not cfg.compact_at_commit, (
-        "KV fuzzing needs cfg.compact_at_commit=False: the compaction "
-        "boundary must follow the apply cursor, not the commit index"
-    )
+    if kn is None:
+        _check_kv_cfg(cfg)
+        kn = cfg.knobs()
+    if kkn is None:
+        kkn = kcfg.knobs()
     n, cap, nc = cfg.n_nodes, cfg.log_cap, kcfg.n_clients
     me = jnp.arange(n, dtype=I32)
 
     pre = ks.raft
-    s = step_cluster(cfg, pre, cluster_key)
+    s = step_cluster(cfg, pre, cluster_key, kn)
     t = s.tick
     key = jax.random.fold_in(cluster_key, t)
     nk = kcfg.n_keys
@@ -274,7 +313,7 @@ def kv_step(
     # All row-indexed reads/writes are one-hot mask-reduces over the (tiny)
     # lane axes — dynamic per-row gathers/scatters serialize on TPU.
     viol = jnp.asarray(0, I32)
-    limit = s.log_len if kcfg.bug_apply_uncommitted else s.commit
+    limit = jnp.where(kkn.bug_apply_uncommitted, s.log_len, s.commit)
     lane = jnp.arange(cap, dtype=I32)[None, :]
     cl_lane = jnp.arange(nc, dtype=I32)[None, :]
     k_lane = jnp.arange(kcfg.n_keys, dtype=I32)[None, :]
@@ -293,10 +332,10 @@ def kv_step(
         # starts s+1 only after s committed, so committed order is gap-free).
         # bug_stale_read serves Gets outside the log, so gaps are legitimate
         # there and the gap-based checks stand down.
-        if not kcfg.bug_stale_read:
-            viol |= jnp.where(jnp.any(can & ~dup & (seq > prev + 1)),
-                              VIOLATION_EXACTLY_ONCE, 0)
-        do = can if kcfg.bug_skip_dedup else (can & ~dup)
+        viol |= jnp.where(
+            ~kkn.bug_stale_read & jnp.any(can & ~dup & (seq > prev + 1)),
+            VIOLATION_EXACTLY_ONCE, 0)
+        do = can & (kkn.bug_skip_dedup | ~dup)
         # Gets read; only Appends mutate the key state.
         mut = do & (kind == _APPEND)
         k_oh = (k_lane == k[:, None]) & mut[:, None]  # [n, nk]
@@ -326,9 +365,10 @@ def kv_step(
         applied = jnp.where(can, applied + 1, applied)
 
     # exactly-once: ops applied per client == highest seq applied
-    if not kcfg.bug_stale_read:
-        viol |= jnp.where(jnp.any(s.alive[:, None] & (apply_count != last_seq)),
-                          VIOLATION_EXACTLY_ONCE, 0)
+    viol |= jnp.where(
+        ~kkn.bug_stale_read
+        & jnp.any(s.alive[:, None] & (apply_count != last_seq)),
+        VIOLATION_EXACTLY_ONCE, 0)
 
     # state-machine agreement: equal cursors => identical applied state
     same_cursor = (
@@ -380,7 +420,7 @@ def kv_step(
     kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 4)
     start = (
         ~clerk_out
-        & jax.random.bernoulli(kk[0], kcfg.p_op, (nc,))
+        & jax.random.bernoulli(kk[0], kkn.p_op, (nc,))
         & (ks.clerk_seq < _SEQ_LIM - 1)
     )
     clerk_seq = jnp.where(start, ks.clerk_seq + 1, ks.clerk_seq)
@@ -392,7 +432,7 @@ def kv_step(
     clerk_kind = jnp.where(
         start,
         jax.random.bernoulli(
-            jax.random.fold_in(key, _S_CLERK_KIND), kcfg.p_get, (nc,)
+            jax.random.fold_in(key, _S_CLERK_KIND), kkn.p_get, (nc,)
         ).astype(I32),
         ks.clerk_kind,
     )
@@ -405,49 +445,50 @@ def kv_step(
     clerk_get_obs = jnp.where(start, -1, clerk_get_obs)
     clerk_out = clerk_out | start
     retry = clerk_out & (
-        start | jax.random.bernoulli(kk[2], kcfg.p_retry, (nc,))
+        start | jax.random.bernoulli(kk[2], kkn.p_retry, (nc,))
     )
     target = jax.random.randint(kk[3], (nc,), 0, n, dtype=I32)
 
-    if kcfg.bug_stale_read:
-        # Bug mode: the contacted node — leader or not — serves the Get
-        # immediately from its own (possibly lagging) applied state, skipping
-        # the log. The classic read-from-follower bug; the linearizability
-        # oracle must flag any observation below the invoke-time truth.
-        tgt_oh = me[None, :] == target[:, None]  # [nc, n]
-        local_cnt = jnp.sum(
-            jnp.where(
-                tgt_oh[:, :, None]
-                & (jnp.arange(nk, dtype=I32)[None, None, :]
-                   == clerk_key[:, None, None]),
-                key_count[None, :, :], 0,
-            ),
-            axis=(1, 2),
-        )  # [nc]: key_count[target_c, key_c]
-        # ~start: the serve "RPC" takes at least a tick, so an op never
-        # completes in its start tick — this also keeps completions of
-        # consecutive ops on distinct ticks, which the history exporter's
-        # per-tick clerk_last_obs snapshot relies on (bridge.py)
-        served = (
-            retry & ~start
-            & (clerk_kind == _GET)
-            & jnp.any(tgt_oh & s.alive[None, :], axis=1)
-        )
-        # upper bound = truth at serve time — identical to truth_at_new above
-        # (same clerk_key, same truth_count; nothing commits in between)
-        viol |= jnp.where(
-            jnp.any(
-                served
-                & ((local_cnt < clerk_get_lo) | (local_cnt > truth_at_new))
-            ),
-            VIOLATION_STALE_READ, 0,
-        )
-        clerk_acked = jnp.where(served, clerk_seq, clerk_acked)
-        clerk_out = clerk_out & ~served
-        gets_done = gets_done + served.astype(I32)
-        retry = retry & ~served
-        # record the served value so history exporters (bridge) can see it
-        clerk_last_obs = jnp.where(served, local_cnt, clerk_last_obs)
+    # Bug mode (dynamic knob; a no-op mask when off): the contacted node —
+    # leader or not — serves the Get immediately from its own (possibly
+    # lagging) applied state, skipping the log. The classic read-from-follower
+    # bug; the linearizability oracle must flag any observation below the
+    # invoke-time truth.
+    tgt_oh = me[None, :] == target[:, None]  # [nc, n]
+    local_cnt = jnp.sum(
+        jnp.where(
+            tgt_oh[:, :, None]
+            & (jnp.arange(nk, dtype=I32)[None, None, :]
+               == clerk_key[:, None, None]),
+            key_count[None, :, :], 0,
+        ),
+        axis=(1, 2),
+    )  # [nc]: key_count[target_c, key_c]
+    # ~start: the serve "RPC" takes at least a tick, so an op never
+    # completes in its start tick — this also keeps completions of
+    # consecutive ops on distinct ticks, which the history exporter's
+    # per-tick clerk_last_obs snapshot relies on (bridge.py)
+    served = (
+        kkn.bug_stale_read
+        & retry & ~start
+        & (clerk_kind == _GET)
+        & jnp.any(tgt_oh & s.alive[None, :], axis=1)
+    )
+    # upper bound = truth at serve time — identical to truth_at_new above
+    # (same clerk_key, same truth_count; nothing commits in between)
+    viol |= jnp.where(
+        jnp.any(
+            served
+            & ((local_cnt < clerk_get_lo) | (local_cnt > truth_at_new))
+        ),
+        VIOLATION_STALE_READ, 0,
+    )
+    clerk_acked = jnp.where(served, clerk_seq, clerk_acked)
+    clerk_out = clerk_out & ~served
+    gets_done = gets_done + served.astype(I32)
+    retry = retry & ~served
+    # record the served value so history exporters (bridge) can see it
+    clerk_last_obs = jnp.where(served, local_cnt, clerk_last_obs)
 
     violations = s.violations | viol
     first_violation_tick = jnp.where(
@@ -528,6 +569,41 @@ class KvFuzzReport(NamedTuple):
         return np.nonzero(self.violations != 0)[0]
 
 
+@functools.lru_cache(maxsize=None)
+def _kv_program(
+    static_cfg: SimConfig, static_kcfg: KvConfig, n_clusters: int,
+    mesh: Optional[Mesh],
+):
+    """One compiled program per static shape; probabilities, bug modes, and
+    the tick count are runtime arguments (see engine._fuzz_program)."""
+    constraint = None
+    if mesh is not None:
+        constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
+
+    def run(seed, kn, kkn, n_ticks) -> KvState:
+        base = jax.random.PRNGKey(seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(n_clusters)
+        )
+        states = jax.vmap(
+            functools.partial(init_kv_cluster, static_cfg, static_kcfg)
+        )(keys, kn)
+        if constraint is not None:
+            states = jax.lax.with_sharding_constraint(
+                states, jax.tree.map(lambda _: constraint, states)
+            )
+            keys = jax.lax.with_sharding_constraint(keys, constraint)
+
+        def body(_, carry):
+            return jax.vmap(
+                functools.partial(kv_step, static_cfg, static_kcfg)
+            )(carry, keys, kn, kkn)
+
+        return jax.lax.fori_loop(0, n_ticks, body, states)
+
+    return jax.jit(run)
+
+
 def make_kv_fuzz_fn(
     cfg: SimConfig,
     kcfg: KvConfig,
@@ -535,31 +611,15 @@ def make_kv_fuzz_fn(
     n_ticks: int,
     mesh: Optional[Mesh] = None,
 ):
-    """Build a jitted fn(seed) -> final batched KvState (see engine.make_fuzz_fn)."""
-    constraint = None
-    if mesh is not None:
-        constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
-
-    def run(seed) -> KvState:
-        base = jax.random.PRNGKey(seed)
-        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-            jnp.arange(n_clusters)
-        )
-        states = jax.vmap(functools.partial(init_kv_cluster, cfg, kcfg))(keys)
-        if constraint is not None:
-            states = jax.lax.with_sharding_constraint(
-                states, jax.tree.map(lambda _: constraint, states)
-            )
-            keys = jax.lax.with_sharding_constraint(keys, constraint)
-
-        def body(carry, _):
-            nxt = jax.vmap(functools.partial(kv_step, cfg, kcfg))(carry, keys)
-            return nxt, None
-
-        final, _ = jax.lax.scan(body, states, None, length=n_ticks)
-        return final
-
-    return jax.jit(run)
+    """Build fn(seed) -> final batched KvState (see engine.make_fuzz_fn)."""
+    _check_kv_cfg(cfg)
+    prog = _kv_program(cfg.static_key(), kcfg.static_key(), n_clusters, mesh)
+    kn = cfg.knobs().broadcast(n_clusters)
+    kkn = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (n_clusters,)), kcfg.knobs()
+    )
+    ticks = jnp.asarray(n_ticks, jnp.int32)
+    return lambda seed: prog(seed, kn, kkn, ticks)
 
 
 def kv_report(final: KvState) -> KvFuzzReport:
@@ -588,15 +648,27 @@ def kv_fuzz(
     return kv_report(final)
 
 
+@functools.lru_cache(maxsize=None)
+def _kv_replay_program(static_cfg: SimConfig, static_kcfg: KvConfig):
+    def run(cluster_id, kn, kkn, n_ticks, seed):
+        ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
+        state = init_kv_cluster(static_cfg, static_kcfg, ckey, kn)
+
+        def body(_, carry):
+            return kv_step(static_cfg, static_kcfg, carry, ckey, kn, kkn)
+
+        return jax.lax.fori_loop(0, n_ticks, body, state)
+
+    return jax.jit(run)
+
+
 def kv_replay_cluster(
     cfg: SimConfig, kcfg: KvConfig, seed: int, cluster_id: int, n_ticks: int
 ) -> KvState:
     """Re-run one cluster for inspection (the (seed, cluster_id) replay contract)."""
-    ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
-    state = init_kv_cluster(cfg, kcfg, ckey)
-
-    def body(carry, _):
-        return kv_step(cfg, kcfg, carry, ckey), None
-
-    final, _ = jax.lax.scan(body, state, None, length=n_ticks)
-    return jax.block_until_ready(final)
+    _check_kv_cfg(cfg)
+    prog = _kv_replay_program(cfg.static_key(), kcfg.static_key())
+    return jax.block_until_ready(
+        prog(jnp.asarray(cluster_id, jnp.int32), cfg.knobs(), kcfg.knobs(),
+             jnp.asarray(n_ticks, jnp.int32), jnp.asarray(seed, jnp.uint32))
+    )

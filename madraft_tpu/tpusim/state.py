@@ -124,13 +124,19 @@ class ClusterState(NamedTuple):
     snap_install_count: jax.Array  # i32 scalar: snapshot installs (2D metric)
 
 
-def init_cluster(cfg: SimConfig, key: jax.Array) -> ClusterState:
-    """Fresh cluster at tick 0 with randomized election timers (raft.rs:260-263)."""
+def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
+    """Fresh cluster at tick 0 with randomized election timers (raft.rs:260-263).
+
+    ``kn`` (a ``config.Knobs``) carries the dynamic knobs as traced scalars;
+    omitted, they are baked from ``cfg`` as constants (single-config callers).
+    """
+    if kn is None:
+        kn = cfg.knobs()
     n, cap, ae = cfg.n_nodes, cfg.log_cap, cfg.ae_max
     zn = jnp.zeros((n,), I32)
     znn = jnp.zeros((n, n), I32)
     timer = jax.random.randint(
-        key, (n,), cfg.election_timeout_min, cfg.election_timeout_max + 1, dtype=I32
+        key, (n,), kn.eto_min, kn.eto_max + 1, dtype=I32
     )
     return ClusterState(
         tick=jnp.asarray(0, I32),

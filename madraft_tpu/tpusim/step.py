@@ -93,11 +93,17 @@ class _DrawBlock:
         self.off += size
         return out
 
-    def bern(self, p: float, shape):
-        return self._take(shape) < jnp.uint32(min(max(p, 0.0), 1.0) * 4294967295.0)
+    def bern(self, p, shape):
+        # p may be a traced f32 scalar (dynamic knob); compare in [0,1) space
+        # (u32 -> f32 quantizes the draw to 2^-24 granularity — irrelevant at
+        # fuzzing probabilities, and identical across replays by construction).
+        u = self._take(shape).astype(jnp.float32) * jnp.float32(2.0 ** -32)
+        return u < p
 
-    def randint(self, lo: int, hi: int, shape):  # [lo, hi)
-        return (lo + (self._take(shape) % jnp.uint32(hi - lo))).astype(I32)
+    def randint(self, lo, hi, shape):  # [lo, hi); bounds may be traced i32
+        span = (jnp.asarray(hi, I32) - jnp.asarray(lo, I32)).astype(jnp.uint32)
+        return (jnp.asarray(lo, I32)
+                + (self._take(shape) % span).astype(I32))
 
     def uniform(self, shape):
         return self._take(shape).astype(jnp.float32) * jnp.float32(2.0 ** -32)
@@ -109,14 +115,14 @@ def _block_total(n: int) -> int:
     return 13 * n + 1 + 6 * n * n
 
 
-def _timeout_draw(cfg: SimConfig, blk: "_DrawBlock", shape) -> jax.Array:
-    return blk.randint(cfg.election_timeout_min, cfg.election_timeout_max + 1, shape)
+def _timeout_draw(kn, blk: "_DrawBlock", shape) -> jax.Array:
+    return blk.randint(kn.eto_min, kn.eto_max + 1, shape)
 
 
-def _net_draws(cfg: SimConfig, blk: "_DrawBlock", shape):
+def _net_draws(kn, blk: "_DrawBlock", shape):
     """(delay, lost) draws for a batch of sends."""
-    delay = blk.randint(cfg.delay_min, cfg.delay_max + 1, shape)
-    lost = blk.bern(cfg.loss_prob, shape)
+    delay = blk.randint(kn.delay_min, kn.delay_max + 1, shape)
+    lost = blk.bern(kn.loss_prob, shape)
     return delay, lost
 
 
@@ -161,7 +167,11 @@ def _term_at(log_term, snap_term, base, abs_idx, cap):
     )
 
 
-def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> ClusterState:
+def step_cluster(
+    cfg: SimConfig, s: ClusterState, cluster_key: jax.Array, kn=None
+) -> ClusterState:
+    if kn is None:  # single-config callers: bake the knobs as constants
+        kn = cfg.knobs()
     n, cap, ae_max = cfg.n_nodes, cfg.log_cap, cfg.ae_max
     t = s.tick + 1  # messages sent at tick t-1 with delay 1 arrive now
     key = jax.random.fold_in(cluster_key, t)
@@ -170,11 +180,11 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     eye = jnp.eye(n, dtype=jnp.bool_)
 
     # ------------------------------------------------------------------ faults
-    restart = (~s.alive) & blk.bern(cfg.p_restart, (n,))
-    crash_draw = s.alive & blk.bern(cfg.p_crash, (n,))
+    restart = (~s.alive) & blk.bern(kn.p_restart, (n,))
+    crash_draw = s.alive & blk.bern(kn.p_crash, (n,))
     # Keep a quorum-capable cluster: at most max_dead simultaneously-dead nodes.
     dead_after_restart = jnp.sum((~s.alive) & (~restart))
-    budget = jnp.asarray(cfg.max_dead, I32) - dead_after_restart
+    budget = kn.max_dead - dead_after_restart
     crash = crash_draw & (jnp.cumsum(crash_draw.astype(I32)) <= budget)
     alive = (s.alive | restart) & ~crash
 
@@ -182,7 +192,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     # the volatile set resets — raft.rs:194-211 restore(), tester.rs:284-327).
     # The snapshot covers 1..base, so commit restarts at base, not 0.
     role = jnp.where(restart, FOLLOWER, s.role)
-    timer = jnp.where(restart, _timeout_draw(cfg, blk, (n,)), s.timer)
+    timer = jnp.where(restart, _timeout_draw(kn, blk, (n,)), s.timer)
     hb = jnp.where(restart, 0, s.hb)
     commit = jnp.where(restart, s.base, s.commit)
     compact_floor = jnp.where(restart, s.base, s.compact_floor)
@@ -195,8 +205,8 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     u_part = blk.uniform(())
     colors = blk.bern(0.5, (n,))
     part_adj = colors[:, None] == colors[None, :]
-    do_part = u_part < cfg.p_repartition
-    do_heal = (~do_part) & (u_part < cfg.p_repartition + cfg.p_heal)
+    do_part = u_part < kn.p_repartition
+    do_heal = (~do_part) & (u_part < kn.p_repartition + kn.p_heal)
     adj = jnp.where(do_part, part_adj, jnp.where(do_heal, True, s.adj)) | eye
 
     term, voted_for = s.term, s.voted_for
@@ -249,7 +259,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     acc = got & (mterm == term)
     role = jnp.where(acc & (role == CANDIDATE), FOLLOWER, role)
     # current-leader contact resets the election timer
-    timer = jnp.where(acc, _timeout_draw(cfg, blk, (n,)), timer)
+    timer = jnp.where(acc, _timeout_draw(kn, blk, (n,)), timer)
     slen = picked(pick, jnp.broadcast_to(s.base[None, :], (n, n)))
     sterm_snap = picked(pick, jnp.broadcast_to(s.snap_term[None, :], (n, n)))
     # cond_install (raft.rs:153): ignore a snapshot behind our commit.
@@ -303,8 +313,8 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         (voted_for == -1) | (voted_for == src_id)
     ) & log_ok
     voted_for = jnp.where(grant, src_id, voted_for)
-    timer = jnp.where(grant, _timeout_draw(cfg, blk, (n,)), timer)
-    delay, lost = _net_draws(cfg, blk, (n,))
+    timer = jnp.where(grant, _timeout_draw(kn, blk, (n,)), timer)
+    delay, lost = _net_draws(kn, blk, (n,))
     send = got & ~lost  # per voter (one response per tick)
     # response slot [candidate, voter] <- the picked (voter, candidate) pair
     resp = pick.T & send[None, :]
@@ -326,7 +336,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     voted_for = jnp.where(higher, -1, voted_for)
     acc = got & (mterm == term)  # AppendEntries from the current-term leader
     role = jnp.where(acc & (role == CANDIDATE), FOLLOWER, role)
-    timer = jnp.where(acc, _timeout_draw(cfg, blk, (n,)), timer)
+    timer = jnp.where(acc, _timeout_draw(kn, blk, (n,)), timer)
     prev = picked(pick, s.ae_req_prev)
     # prev at-or-below our snapshot boundary is committed => matches by
     # definition; otherwise the terms must agree (log-matching check).
@@ -387,7 +397,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         jnp.maximum(jnp.where(has_cand, first_abs - 1, base), base),
     )
     rsp_match = jnp.where(success, batch_end, hint)
-    delay, lost = _net_draws(cfg, blk, (n,))
+    delay, lost = _net_draws(kn, blk, (n,))
     send = got & ~lost  # per follower (one response per tick)
     resp = pick.T & send[None, :]  # slot [leader, follower]
     ae_rsp_t = jnp.where(resp, (t + delay)[None, :], ae_rsp_t)
@@ -443,7 +453,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     )
 
     # Candidate -> leader on majority (election win; raft.rs:286-292 drain path).
-    win = alive & (role == CANDIDATE) & (jnp.sum(votes, axis=1) >= cfg.majority)
+    win = alive & (role == CANDIDATE) & (jnp.sum(votes, axis=1) >= kn.majority)
     role = jnp.where(win, LEADER, role)
     next_idx = jnp.where(win[:, None], log_len[:, None] + 1, next_idx)
     match_idx = jnp.where(win[:, None], 0, match_idx)
@@ -457,12 +467,12 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     role = jnp.where(fired, CANDIDATE, role)
     voted_for = jnp.where(fired, me, voted_for)
     votes = jnp.where(fired[:, None], eye, votes)
-    timer = jnp.where(fired, _timeout_draw(cfg, blk, (n,)), timer)
+    timer = jnp.where(fired, _timeout_draw(kn, blk, (n,)), timer)
 
     llt = jnp.where(
         log_len > base, _row_gather(log_term, _slot(log_len, cap), cap), snap_term
     )
-    delay, lost = _net_draws(cfg, blk, (n, n))
+    delay, lost = _net_draws(kn, blk, (n, n))
     send_rv = fired[None, :] & ~eye & adj.T & ~lost  # [dst, src], link src->dst
     rv_req_t = jnp.where(send_rv, t + delay, rv_req_t)
     rv_req_term = jnp.where(send_rv, term[None, :], s.rv_req_term)
@@ -471,7 +481,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
 
     # --------------------------------------- client command injection at leaders
     lead = alive & (role == LEADER)
-    inject = lead & blk.bern(cfg.p_client_cmd, (n,)) & (log_len - base < cap)
+    inject = lead & blk.bern(kn.p_client_cmd, (n,)) & (log_len - base < cap)
     cmd_val = s.next_cmd * n + me + 1  # unique within the cluster, never 0
     inj_hit = inject[:, None] & (lane == _slot(log_len + 1, cap)[:, None])
     log_term = jnp.where(inj_hit, term[:, None], log_term)
@@ -482,7 +492,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     # -------------------------------------------- leader heartbeat / replication
     hb = jnp.where(lead, hb - 1, hb)
     fire_hb = lead & (hb <= 0)
-    hb = jnp.where(fire_hb, cfg.heartbeat_ticks, hb)
+    hb = jnp.where(fire_hb, kn.heartbeat_ticks, hb)
     # A peer behind the leader's snapshot boundary gets an install-snapshot
     # trigger instead of entries (raft.rs:159 InstallSnapshot).
     need_snap = next_idx.T <= base[None, :]  # [dst, src]
@@ -501,7 +511,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
         jnp.sum(jnp.where(oh_p, log_term[None, :, :], 0), axis=-1),
         snap_term[None, :],
     )
-    delay, lost = _net_draws(cfg, blk, (n, n))
+    delay, lost = _net_draws(kn, blk, (n, n))
     # Eager replication: a leader with unsent entries for a peer fires an AE
     # at once — the reference replicates on start() immediately
     # (raft.rs:266-293 fan-out); the heartbeat cadence governs only the idle
@@ -518,7 +528,7 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     ae_req_commit = jnp.where(send_ae, commit[None, :], s.ae_req_commit)
     ae_req_ent_term = jnp.where(send_ae[:, :, None], ent_t, s.ae_req_ent_term)
     ae_req_ent_val = jnp.where(send_ae[:, :, None], ent_v, s.ae_req_ent_val)
-    delay_sn, lost_sn = _net_draws(cfg, blk, (n, n))
+    delay_sn, lost_sn = _net_draws(kn, blk, (n, n))
     send_sn = fire_hb[None, :] & ~eye & adj.T & ~lost_sn & need_snap
     sn_req_t = jnp.where(send_sn, t + delay_sn, sn_req_t)
     sn_req_term = jnp.where(send_sn, term[None, :], s.sn_req_term)
@@ -527,7 +537,13 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
 
     # ------------------------------------------------------------ commit advance
     mi = jnp.where(eye, log_len[:, None], match_idx)
-    kth = -jnp.sort(-mi, axis=1)[:, cfg.majority - 1]  # majority-th largest match
+    # majority-th largest match; the quorum size is a dynamic knob, so the
+    # column pick is a (uniform-index) take_along_axis, not a static slice
+    kth = jnp.take_along_axis(
+        -jnp.sort(-mi, axis=1),
+        jnp.broadcast_to(jnp.clip(kn.majority - 1, 0, n - 1), (n, 1)),
+        axis=1,
+    )[:, 0]
     cur_term_ok = (kth > base) & (
         _term_at(log_term, snap_term, base, kth, cap) == term
     )
@@ -624,8 +640,10 @@ def step_cluster(cfg: SimConfig, s: ClusterState, cluster_key: jax.Array) -> Clu
     # accumulated past base. With the canonical ring this is a pure index
     # bump — no data movement. Service layers observe base advancing and
     # capture their own state (kv.py).
-    boundary = commit if cfg.compact_at_commit else jnp.minimum(compact_floor, commit)
-    do_compact = alive & (boundary - base >= cfg.compact_every)
+    boundary = jnp.where(
+        kn.compact_at_commit, commit, jnp.minimum(compact_floor, commit)
+    )
+    do_compact = alive & (boundary - base >= kn.compact_every)
     new_snap_term = _term_at(log_term, snap_term, base, boundary, cap)
     # fold the entries crossing the boundary into the node's prefix hash
     out_lanes = do_compact[:, None] & (abs_arr <= boundary[:, None])
