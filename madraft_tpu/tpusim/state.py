@@ -85,10 +85,11 @@ class ClusterState(NamedTuple):
     ae_req_term: jax.Array
     ae_req_prev: jax.Array     # prev log index (count before batch)
     ae_req_prev_term: jax.Array
-    ae_req_n: jax.Array        # entries carried (<= ae_max)
+    ae_req_n: jax.Array        # entries carried (<= ae_max); the entry
+    #                            payload itself is read from the sender's
+    #                            live log at delivery (read-at-delivery, see
+    #                            step.py AE delivery) — no entry mailboxes
     ae_req_commit: jax.Array   # leader commit
-    ae_req_ent_term: jax.Array  # i32 [N, N, AE_MAX]
-    ae_req_ent_val: jax.Array   # i32 [N, N, AE_MAX]
     # AppendEntries response mailbox [dst(leader), src(follower)]
     ae_rsp_t: jax.Array
     ae_rsp_term: jax.Array
@@ -132,7 +133,7 @@ def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
     """
     if kn is None:
         kn = cfg.knobs()
-    n, cap, ae = cfg.n_nodes, cfg.log_cap, cfg.ae_max
+    n, cap = cfg.n_nodes, cfg.log_cap
     zn = jnp.zeros((n,), I32)
     znn = jnp.zeros((n, n), I32)
     timer = jax.random.randint(
@@ -162,8 +163,6 @@ def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
         rv_rsp_t=znn, rv_rsp_term=znn, rv_rsp_granted=jnp.zeros((n, n), BOOL),
         ae_req_t=znn, ae_req_term=znn, ae_req_prev=znn, ae_req_prev_term=znn,
         ae_req_n=znn, ae_req_commit=znn,
-        ae_req_ent_term=jnp.zeros((n, n, ae), I32),
-        ae_req_ent_val=jnp.zeros((n, n, ae), I32),
         ae_rsp_t=znn, ae_rsp_term=znn,
         ae_rsp_success=jnp.zeros((n, n), BOOL), ae_rsp_match=znn,
         sn_req_t=znn,

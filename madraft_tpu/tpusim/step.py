@@ -77,8 +77,9 @@ class _DrawBlock:
     fixed order — same determinism contract (a pure function of the key),
     one PRNG invocation.
 
-    randint uses modulo (negligible bias for the tiny spans here; the
-    election-timeout span is a power of two, so it is exact).
+    randint uses modulo (negligible bias for the tiny spans here; exact when
+    the span is a power of two, which the default timeout span is — swept
+    configs may use any span).
     """
 
     def __init__(self, key: jax.Array, total: int):
@@ -94,10 +95,11 @@ class _DrawBlock:
         return out
 
     def bern(self, p, shape):
-        # p may be a traced f32 scalar (dynamic knob); compare in [0,1) space
-        # (u32 -> f32 quantizes the draw to 2^-24 granularity — irrelevant at
-        # fuzzing probabilities, and identical across replays by construction).
-        u = self._take(shape).astype(jnp.float32) * jnp.float32(2.0 ** -32)
+        # p may be a traced f32 scalar (dynamic knob); compare in [0,1) space.
+        # The draw keeps 24 bits so the f32 conversion is exact and u < 1.0
+        # always holds — p=1.0 knobs (deterministic schedules for oracle
+        # validation) fire every tick, with no round-up-to-1.0 corner.
+        u = (self._take(shape) >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
         return u < p
 
     def randint(self, lo, hi, shape):  # [lo, hi); bounds may be traced i32
@@ -106,7 +108,8 @@ class _DrawBlock:
                 + (self._take(shape) % span).astype(I32))
 
     def uniform(self, shape):
-        return self._take(shape).astype(jnp.float32) * jnp.float32(2.0 ** -32)
+        # same 24-bit treatment as bern(): values are exact in f32 and < 1.0
+        return (self._take(shape) >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
 
 
 def _block_total(n: int) -> int:
@@ -323,6 +326,20 @@ def step_cluster(
     rv_rsp_granted = jnp.where(resp, grant[None, :], rv_rsp_granted)
 
     # ----------------------------------------------------- deliver: AE requests
+    # Entry payloads are read from the SENDER's live log at delivery (the
+    # same read-at-delivery model the install-snapshot path uses). This is
+    # the round-3 perf redesign: the send-side per-(dst, src) entry gather
+    # materialized a [n, n, ae_max, cap] one-hot and two [n, n, ae_max]
+    # mailbox tensors — the measured top phase cost. Reading at delivery
+    # folds over the ONE picked source per destination, so the gather is
+    # [dst, cap] + per-entry [dst, cap] one-hots, and the entry mailboxes
+    # vanish from the state entirely. Safety is unchanged: any (index, term,
+    # value) triple present in a node's ring at delivery was minted by that
+    # term's leader at that index, so delivering it preserves log matching;
+    # if the sender compacted past prev mid-flight the message degrades to a
+    # heartbeat (it would have sent an install-snapshot by now), and if its
+    # log shrank (conflict truncation) the batch tail is dropped — both are
+    # valid AppendEntries a correct sender could have sent.
     lane = jnp.arange(cap, dtype=I32)[None, :]
     pick, defer, due = pick_one(s.ae_req_t)
     ae_req_t = jnp.where((s.ae_req_t == t) & ~defer, 0, s.ae_req_t)
@@ -338,19 +355,43 @@ def step_cluster(
     role = jnp.where(acc & (role == CANDIDATE), FOLLOWER, role)
     timer = jnp.where(acc, _timeout_draw(kn, blk, (n,)), timer)
     prev = picked(pick, s.ae_req_prev)
+    mprev_term = picked(pick, s.ae_req_prev_term)
     # prev at-or-below our snapshot boundary is committed => matches by
     # definition; otherwise the terms must agree (log-matching check).
     prev_ok = (prev <= log_len) & (
         (prev <= base)
-        | (_term_at(log_term, snap_term, base, prev, cap)
-           == picked(pick, s.ae_req_prev_term))
+        | (_term_at(log_term, snap_term, base, prev, cap) == mprev_term)
     )
     success = acc & prev_ok
-    nent = picked(pick, s.ae_req_n)
-    ent_t_d = jnp.sum(
-        jnp.where(pick[:, :, None], s.ae_req_ent_term, 0), axis=1
-    )  # [dst, e]
-    ent_v_d = jnp.sum(jnp.where(pick[:, :, None], s.ae_req_ent_val, 0), axis=1)
+    # the picked sender's log, base, and length AT DELIVERY
+    plog_t = jnp.sum(jnp.where(pick[:, :, None], log_term[None, :, :], 0), axis=1)
+    plog_v = jnp.sum(jnp.where(pick[:, :, None], log_val[None, :, :], 0), axis=1)
+    psrc_base = picked(pick, jnp.broadcast_to(base[None, :], (n, n)))
+    psrc_len = picked(pick, jnp.broadcast_to(log_len[None, :], (n, n)))
+    psrc_snap_term = picked(pick, jnp.broadcast_to(snap_term[None, :], (n, n)))
+    # The (prev_term, entries) pair must describe ONE consistent log — the
+    # AE induction (receiver@prev term == sender@prev term => identical
+    # prefixes => appending the sender's suffix preserves log matching)
+    # breaks if prev_term was probed on the send-time log but entries come
+    # from a delivery-time log that was meanwhile overwritten by a newer
+    # leader. So the sender's CURRENT term at prev must still equal the
+    # message's prev_term; otherwise the message degrades to a heartbeat
+    # (0 entries), like the compacted-past-prev case.
+    cur_prev_term = jnp.where(
+        prev == psrc_base,
+        psrc_snap_term,
+        jnp.sum(jnp.where(lane == _slot(prev, cap)[:, None], plog_t, 0), axis=-1),
+    )
+    prev_still = (
+        (psrc_base <= prev) & (prev <= psrc_len) & (cur_prev_term == mprev_term)
+    )
+    # effective batch: prev re-validation failed or compacted-past-prev =>
+    # heartbeat; sender log shrunk => tail drop. Always contiguous from prev+1.
+    nent = jnp.where(
+        prev_still,
+        jnp.clip(jnp.minimum(picked(pick, s.ae_req_n), psrc_len - prev), 0, ae_max),
+        0,
+    )
     conflict_any = jnp.zeros((n,), jnp.bool_)
     for e in range(ae_max):
         abs_idx = prev + e + 1          # 1-based absolute index of entry e
@@ -360,14 +401,17 @@ def step_cluster(
         in_batch = (
             success & (e < nent) & (abs_idx > base) & (abs_idx <= base + cap)
         )
-        ent_t = ent_t_d[:, e]
-        ent_v = ent_v_d[:, e]
         slot = _slot(abs_idx, cap)
+        # the canonical ring makes the sender read lane and the receiver
+        # write lane the SAME mask — one one-hot serves both
+        slot_oh = lane == slot[:, None]
+        ent_t = jnp.sum(jnp.where(slot_oh, plog_t, 0), axis=-1)
+        ent_v = jnp.sum(jnp.where(slot_oh, plog_v, 0), axis=-1)
         conflict_any |= in_batch & (abs_idx <= log_len) & (
             _row_gather(log_term, slot, cap) != ent_t
         )
         # one-hot lane select instead of a dynamic per-row scatter
-        hit = in_batch[:, None] & (lane == slot[:, None])
+        hit = in_batch[:, None] & slot_oh
         log_term = jnp.where(hit, ent_t[:, None], log_term)
         log_val = jnp.where(hit, ent_v[:, None], log_val)
     batch_end = jnp.minimum(prev + nent, base + cap)  # ring overflow: drop tail
@@ -498,13 +542,9 @@ def step_cluster(
     need_snap = next_idx.T <= base[None, :]  # [dst, src]
     prev_m = next_idx.T - 1  # [dst, src]: src's prev index for dst
     n_m = jnp.clip(log_len[None, :] - prev_m, 0, ae_max)
-    # entry e for (dst, src) = src's ring lane of abs index prev+1+e, fetched
-    # as a one-hot select+reduce out of src's log (the output is only
-    # [n, n, ae_max+1] values; dynamic gathers serialize on TPU).
-    idxs = _slot(prev_m[:, :, None] + 1 + jnp.arange(ae_max, dtype=I32), cap)
-    oh_e = jnp.arange(cap, dtype=I32) == idxs[..., None]  # [dst, src, e, k]
-    ent_t = jnp.sum(jnp.where(oh_e, log_term[None, :, None, :], 0), axis=-1)
-    ent_v = jnp.sum(jnp.where(oh_e, log_val[None, :, None, :], 0), axis=-1)
+    # Entry payloads are NOT gathered here — the delivery phase reads them
+    # from the sender's live log (read-at-delivery; see the AE delivery
+    # comment). Only prev's term is resolved at send (the log-matching probe).
     oh_p = jnp.arange(cap, dtype=I32) == _slot(prev_m, cap)[..., None]
     prev_term_m = jnp.where(
         prev_m > base[None, :],
@@ -526,8 +566,6 @@ def step_cluster(
     ae_req_prev_term = jnp.where(send_ae, prev_term_m, s.ae_req_prev_term)
     ae_req_n = jnp.where(send_ae, n_m, s.ae_req_n)
     ae_req_commit = jnp.where(send_ae, commit[None, :], s.ae_req_commit)
-    ae_req_ent_term = jnp.where(send_ae[:, :, None], ent_t, s.ae_req_ent_term)
-    ae_req_ent_val = jnp.where(send_ae[:, :, None], ent_v, s.ae_req_ent_val)
     delay_sn, lost_sn = _net_draws(kn, blk, (n, n))
     send_sn = fire_hb[None, :] & ~eye & adj.T & ~lost_sn & need_snap
     sn_req_t = jnp.where(send_sn, t + delay_sn, sn_req_t)
@@ -666,7 +704,6 @@ def step_cluster(
         ae_req_t=ae_req_t, ae_req_term=ae_req_term, ae_req_prev=ae_req_prev,
         ae_req_prev_term=ae_req_prev_term, ae_req_n=ae_req_n,
         ae_req_commit=ae_req_commit,
-        ae_req_ent_term=ae_req_ent_term, ae_req_ent_val=ae_req_ent_val,
         ae_rsp_t=ae_rsp_t, ae_rsp_term=ae_rsp_term,
         ae_rsp_success=ae_rsp_success, ae_rsp_match=ae_rsp_match,
         sn_req_t=sn_req_t,
