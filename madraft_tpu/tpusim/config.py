@@ -41,6 +41,19 @@ class SimConfig:
     def __post_init__(self):
         if self.log_cap <= 0 or self.log_cap & (self.log_cap - 1):
             raise ValueError(f"log_cap must be a power of two, got {self.log_cap}")
+        if self.compact_every < 1:
+            raise ValueError(f"compact_every must be >= 1, got {self.compact_every}")
+        # the leader-no-op liveness argument (step.py win block) needs the
+        # ring to always have room for one current-term entry:
+        # len - base <= flow_cap + compact_every must stay < log_cap
+        if self.flow_cap < 1:
+            raise ValueError(f"flow_cap must be >= 1, got {self.flow_cap}")
+        if self.flow_cap + self.compact_every >= self.log_cap:
+            raise ValueError(
+                f"flow_cap ({self.flow_cap}) + compact_every "
+                f"({self.compact_every}) must stay below log_cap "
+                f"({self.log_cap}) or a full ring can deadlock commit"
+            )
 
     # Log compaction (the Lab 2D snapshot path, raft.rs:149-168): a node
     # discards its window prefix up to the compaction boundary every
@@ -52,6 +65,23 @@ class SimConfig:
     # never outruns the state machine.
     compact_every: int = 16
     compact_at_commit: bool = True
+
+    # Flow control: a leader refuses new proposals (client commands, service
+    # entries) while its uncommitted backlog log_len - commit reaches this
+    # many entries (0 = log_cap // 2). Without it, retry-heavy service layers
+    # can fill the bounded ring with uncommitted old-term entries; after an
+    # election the new leader then has no room to append a current-term
+    # entry, the current-term commit rule (step.py commit advance) can never
+    # fire, and commit/apply/base deadlock permanently. The bound keeps
+    # len - base strictly below cap (given compact_every <= cap/4), so a
+    # fresh leader can always propose and drain the backlog. The reference's
+    # analogue is Server::apply backpressuring on the raft handle — an
+    # unbounded log hides the hazard; a ring must make it explicit.
+    uncommitted_cap: int = 0
+
+    @property
+    def flow_cap(self) -> int:
+        return self.uncommitted_cap or self.log_cap // 2
 
     # Virtual-time quantization: 1 tick ~ 10 simulated ms.
     ms_per_tick: int = 10
@@ -69,6 +99,10 @@ class SimConfig:
     p_restart: float = 0.2      # dead node restarts (recovers persisted state)
     p_repartition: float = 0.0  # network re-partitions into a random 2-coloring
     p_heal: float = 0.0         # network heals to full connectivity
+    p_leader_part: float = 0.0  # leader-in-minority partition (leader + its
+    #                             successor vs the rest; kvraft tester.rs:184-191)
+    p_asym_cut: float = 0.0     # one DIRECTED link goes down (one-sided failure;
+    #                             accumulates until the next repartition/heal)
     max_dead: int = 0           # cap on simultaneously-dead nodes (0 = no crashes)
 
     # Client workload: probability a leader gets a fresh command injected per tick
@@ -97,6 +131,8 @@ class SimConfig:
             p_restart=jnp.float32(self.p_restart),
             p_repartition=jnp.float32(self.p_repartition),
             p_heal=jnp.float32(self.p_heal),
+            p_leader_part=jnp.float32(self.p_leader_part),
+            p_asym_cut=jnp.float32(self.p_asym_cut),
             p_client_cmd=jnp.float32(self.p_client_cmd),
             eto_min=jnp.int32(self.election_timeout_min),
             eto_max=jnp.int32(self.election_timeout_max),
@@ -104,6 +140,7 @@ class SimConfig:
             delay_max=jnp.int32(self.delay_max),
             heartbeat_ticks=jnp.int32(self.heartbeat_ticks),
             compact_every=jnp.int32(self.compact_every),
+            flow_cap=jnp.int32(self.flow_cap),
             max_dead=jnp.int32(self.max_dead),
             majority=jnp.int32(self.majority),
             compact_at_commit=jnp.bool_(self.compact_at_commit),
@@ -131,6 +168,8 @@ class Knobs(NamedTuple):
     p_restart: jax.Array
     p_repartition: jax.Array
     p_heal: jax.Array
+    p_leader_part: jax.Array
+    p_asym_cut: jax.Array
     p_client_cmd: jax.Array
     eto_min: jax.Array
     eto_max: jax.Array
@@ -138,6 +177,7 @@ class Knobs(NamedTuple):
     delay_max: jax.Array
     heartbeat_ticks: jax.Array
     compact_every: jax.Array
+    flow_cap: jax.Array
     max_dead: jax.Array
     majority: jax.Array
     compact_at_commit: jax.Array
@@ -157,3 +197,10 @@ VIOLATION_PREFIX_DIVERGE = 512  # equal snapshot boundaries, different compacted
 
 # Role encoding.
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+
+# Log value of the no-op entry a freshly elected leader appends (step.py win
+# block): guarantees the new term has a committable entry even while flow
+# control gates service proposals. Far above any packed service op or
+# injected command value; service apply machines skip it (kv.py valid guard;
+# shardkv.py's 3-bit kind decodes it as the unused kind 7).
+NOOP_CMD = 1 << 30

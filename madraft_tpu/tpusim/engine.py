@@ -96,7 +96,29 @@ def make_fuzz_fn(
     prog = _fuzz_program(cfg.static_key(), n_clusters, mesh)
     kn = cfg.knobs().broadcast(n_clusters)
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return lambda seed: prog(seed, kn, ticks)
+    # coerce exactly like fuzz()/replay_cluster(): with x64 enabled a
+    # negative or >= 2^32 Python-int seed would otherwise promote to int64
+    # and silently break the (seed, cluster_id) replay contract
+    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, ticks)
+
+
+def _validate_knobs(knobs) -> None:
+    """Eagerly reject knob values that would silently misbehave inside the
+    compiled program (mod-by-zero spans, out-of-range probabilities)."""
+    k = jax.tree.map(np.asarray, knobs)
+    for name in ("loss_prob", "p_crash", "p_restart", "p_repartition",
+                 "p_heal", "p_leader_part", "p_asym_cut", "p_client_cmd"):
+        v = getattr(k, name)
+        if (v < 0).any() or (v > 1).any():
+            raise ValueError(f"knob {name} outside [0, 1]: {v}")
+    if (k.eto_max < k.eto_min).any() or (k.eto_min < 1).any():
+        raise ValueError(f"election timeout span empty: [{k.eto_min}, {k.eto_max}]")
+    if (k.delay_max < k.delay_min).any() or (k.delay_min < 1).any():
+        raise ValueError(f"delay span empty: [{k.delay_min}, {k.delay_max}]")
+    if (k.majority < 1).any() or (k.heartbeat_ticks < 1).any():
+        raise ValueError("majority and heartbeat_ticks must be >= 1")
+    if (k.flow_cap < 1).any() or (k.compact_every < 1).any():
+        raise ValueError("flow_cap and compact_every must be >= 1")
 
 
 def make_sweep_fn(
@@ -109,10 +131,11 @@ def make_sweep_fn(
     """Like make_fuzz_fn, but each cluster runs its own dynamic knobs — a
     fault-parameter sweep (e.g. loss x crash-rate grid) in ONE compiled
     program, something the reference's compile-time test matrix cannot do."""
+    _validate_knobs(knobs)
     prog = _fuzz_program(cfg.static_key(), n_clusters, mesh)
     kn = jax.tree.map(lambda x: jnp.broadcast_to(x, (n_clusters,)), knobs)
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return lambda seed: prog(seed, kn, ticks)
+    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, ticks)
 
 
 def report(final: ClusterState) -> FuzzReport:

@@ -45,7 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from madraft_tpu.tpusim.config import LEADER, SimConfig
+from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
 from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
 from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 
@@ -239,7 +239,10 @@ def kv_step(
     sh_abs_now = _lane_abs(s.shadow_base, cap)  # [cap]
     sh_client, sh_seq, sh_key, sh_kind = _unpack(kcfg, s.shadow_val)
     sh_client = jnp.clip(sh_client, 0, nc - 1)
-    sh_new = (sh_abs_now > pre.shadow_len) & (sh_abs_now <= s.shadow_len)
+    sh_new = (
+        (sh_abs_now > pre.shadow_len) & (sh_abs_now <= s.shadow_len)
+        & (s.shadow_val != NOOP_CMD)  # leader no-ops are not client ops
+    )
     cl_oh_sh = sh_client[:, None] == jnp.arange(nc, dtype=I32)[None, :]  # [cap, nc]
     prev_max_at = jnp.sum(
         jnp.where(cl_oh_sh, ks.truth_max_seq[None, :], 0), axis=1
@@ -325,6 +328,8 @@ def kv_step(
         val = jnp.sum(jnp.where(lane == pos[:, None], s.log_val, 0), axis=-1)
         client, seq, k, kind = _unpack(kcfg, val)
         client = jnp.clip(client, 0, nc - 1)
+        # a leader no-op is consumed (cursor advances) but is no client op
+        is_op = can & (val != NOOP_CMD)
         cl_oh = cl_lane == client[:, None]            # [n, nc]
         prev = jnp.sum(jnp.where(cl_oh, last_seq, 0), axis=-1)
         dup = seq <= prev
@@ -333,9 +338,9 @@ def kv_step(
         # bug_stale_read serves Gets outside the log, so gaps are legitimate
         # there and the gap-based checks stand down.
         viol |= jnp.where(
-            ~kkn.bug_stale_read & jnp.any(can & ~dup & (seq > prev + 1)),
+            ~kkn.bug_stale_read & jnp.any(is_op & ~dup & (seq > prev + 1)),
             VIOLATION_EXACTLY_ONCE, 0)
-        do = can & (kkn.bug_skip_dedup | ~dup)
+        do = is_op & (kkn.bug_skip_dedup | ~dup)
         # Gets read; only Appends mutate the key state.
         mut = do & (kind == _APPEND)
         k_oh = (k_lane == k[:, None]) & mut[:, None]  # [n, nk]
@@ -343,7 +348,7 @@ def kv_step(
         key_count = jnp.where(k_oh, key_count + 1, key_count)
         apply_count = jnp.where(cl_oh & do[:, None], apply_count + 1, apply_count)
         last_seq = jnp.where(
-            cl_oh & can[:, None], jnp.maximum(prev, seq)[:, None], last_seq
+            cl_oh & is_op[:, None], jnp.maximum(prev, seq)[:, None], last_seq
         )
         # Get observation: the value a Get returns is the key's applied-append
         # count at its log position — a pure function of the log prefix, so
@@ -509,6 +514,7 @@ def kv_step(
             & s.alive
             & (s.role == LEADER)
             & (log_len - s.base < cap)  # window has room
+            & (log_len - s.commit < kn.flow_cap)  # proposal backpressure
         )
         v = _pack(kcfg, jnp.asarray(c, I32), clerk_seq[c], clerk_key[c],
                   clerk_kind[c])
@@ -619,7 +625,8 @@ def make_kv_fuzz_fn(
         lambda x: jnp.broadcast_to(x, (n_clusters,)), kcfg.knobs()
     )
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return lambda seed: prog(seed, kn, kkn, ticks)
+    # uint32 coercion: keep the (seed, cluster_id) replay contract under x64
+    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, kkn, ticks)
 
 
 def kv_report(final: KvState) -> KvFuzzReport:

@@ -44,11 +44,18 @@ Oracles (all on-device reductions, sticky violation bits):
   serving at the nodes) and ``bug_drop_dup_table`` (INSTALL resets the dup
   table, so migrated-away retries double-apply).
 
-Entry packing (i32 log values, low 2 bits = kind):
-  APPEND  ((client*SEQ_LIM + seq)*NS + shard)*4 + 0 + 1
-  CONFIG  (cfg_idx)*4 + 1 + 1
-  INSTALL (cfg_idx*NS + shard)*4 + 2 + 1
-  DELETE  (cfg_idx*NS + shard)*4 + 3 + 1
+Entry packing (i32 log values, low 3 bits = kind):
+  APPEND/GET ((client*SEQ_LIM + seq)*NS + shard)*8 + {0,4} + 1
+  CONFIG     (cfg_idx)*8 + 1 + 1
+  INSTALL    (cfg_idx*NS + shard)*8 + 2 + 1
+  DELETE     (cfg_idx*NS + shard)*8 + 3 + 1
+
+Gets ride the log like the reference's committed-read path (msg.rs:10-15,
+client.rs:16-25 WrongGroup routing): accepted only where the shard is OWNED,
+deduped like appends, and checked by a per-shard interval oracle
+(VIOLATION_SHARD_STALE_READ) — a serve-from-frozen-copy bug
+(``bug_serve_frozen``) is the read-side analogue kv.py's stale-read oracle
+catches on the unsharded stack.
 """
 
 from __future__ import annotations
@@ -70,11 +77,17 @@ from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 VIOLATION_SHARD_DIVERGE = 64     # node state != truth walker at equal cursor
 VIOLATION_SHARD_OWNERSHIP = 128  # a shard walker-OWNED by two groups at once
 VIOLATION_SHARD_STORAGE = 256    # state retained for an ABSENT shard (GC leak)
+VIOLATION_SHARD_STALE_READ = 1024  # a Get observed a count outside its
+#                                    invoke..return truth window (the sharded
+#                                    reads-linearizability oracle; kv.py's
+#                                    VIOLATION_STALE_READ across migration)
 
 _SEQ_LIM = 1 << 13
+_BIG = 1 << 30
 
-# Entry kinds.
-_APPEND, _CONFIG, _INSTALL, _DELETE = 0, 1, 2, 3
+# Entry kinds (3 bits; GET rides the log like the reference's committed-read
+# path, /root/reference/src/shardkv/msg.rs:10-15 Reply::Get).
+_APPEND, _CONFIG, _INSTALL, _DELETE, _GET = 0, 1, 2, 3, 4
 # Shard phases.
 ABSENT, OWNED, PULLING, FROZEN = 0, 1, 2, 3
 
@@ -97,43 +110,54 @@ class ShardKvConfig:
     n_configs: int = 6          # length of the pre-drawn config schedule
     cfg_interval: int = 60      # mean ticks between config activations
     p_op: float = 0.4           # idle clerk starts a fresh op
+    p_get: float = 0.3          # a fresh op is a Get (else an Append)
     p_retry: float = 0.5        # pending clerk re-submits this tick
     p_cfg_learn: float = 0.3    # clerk/leader learns a newer config this tick
-    p_pull: float = 0.4         # leader (re)sends pull/ack for a pending shard
+    p_pull: float = 0.4         # leader (re)sends a pull for a PULLING shard
+    p_ack: float = 0.4          # leader (re)sends the post-install ack (the
+    #                             GC trigger; low values stretch the window
+    #                             where the old owner still holds a copy)
     pull_delay_min: int = 1
     pull_delay_max: int = 3
-    pull_loss: float = 0.1      # inter-group message loss
+    pull_loss: float = 0.1      # inter-group message loss (pulls AND acks)
     apply_max: int = 4          # apply-machine entries per node per tick
     walk_max: int = 6           # truth-walker entries per group per tick
     # Oracle-validation bug modes (False = correct service).
     bug_skip_freeze: bool = False    # lost shards keep serving at the nodes
     bug_drop_dup_table: bool = False  # INSTALL resets the migrated dup table
+    bug_serve_frozen: bool = False   # nodes skip the ownership check for
+    #                                  reads: a Get on a non-OWNED shard is
+    #                                  served from whatever local copy exists
+    #                                  (a FROZEN surrendered copy, or nothing
+    #                                  after GC) — the sharded stale-read bug
+    #                                  the interval oracle must catch
 
     def replace(self, **kw) -> "ShardKvConfig":
         return dataclasses.replace(self, **kw)
 
 
-def _pack_append(cfg: ShardKvConfig, client, seq, shard):
-    return (((client * _SEQ_LIM + seq) * cfg.n_shards + shard) * 4 + _APPEND) + 1
+def _pack_op(cfg: ShardKvConfig, client, seq, shard, kind):
+    """APPEND or GET client op."""
+    return (((client * _SEQ_LIM + seq) * cfg.n_shards + shard) * 8 + kind) + 1
 
 
 def _pack_config(cfg_idx):
-    return (cfg_idx * 4 + _CONFIG) + 1
+    return (cfg_idx * 8 + _CONFIG) + 1
 
 
 def _pack_install(cfg: ShardKvConfig, cfg_idx, shard):
-    return ((cfg_idx * cfg.n_shards + shard) * 4 + _INSTALL) + 1
+    return ((cfg_idx * cfg.n_shards + shard) * 8 + _INSTALL) + 1
 
 
 def _pack_delete(cfg: ShardKvConfig, cfg_idx, shard):
-    return ((cfg_idx * cfg.n_shards + shard) * 4 + _DELETE) + 1
+    return ((cfg_idx * cfg.n_shards + shard) * 8 + _DELETE) + 1
 
 
 def _unpack(cfg: ShardKvConfig, val):
     """-> (kind, client, seq, shard, cfg_idx); fields valid per kind."""
     v = val - 1
-    kind = v % 4
-    payload = v // 4
+    kind = v % 8
+    payload = v // 8
     shard = payload % cfg.n_shards
     cs = payload // cfg.n_shards
     client = cs // _SEQ_LIM
@@ -182,8 +206,15 @@ class ShardKvState(NamedTuple):
     clerk_seq: jax.Array
     clerk_out: jax.Array          # bool
     clerk_shard: jax.Array
+    clerk_kind: jax.Array         # i32: _APPEND or _GET
     clerk_cfg: jax.Array          # clerk's believed config index
     clerk_acked: jax.Array
+    # --- reads-linearizability oracle state (kv.py's design per shard:
+    # a shard's state IS its accepted-append count, so a Get is linearizable
+    # iff its observed count lies in [truth at invoke, truth at return]) ---
+    clerk_get_lo: jax.Array       # i32 [NC] truth_count[shard] at invoke
+    clerk_get_obs: jax.Array      # i32 [NC] observed count; -1 = no reply yet
+    gets_done: jax.Array          # i32 [NC] completed Gets
     # --- truth walker (oracle ground truth at each group's shadow frontier) ---
     w_frontier: jax.Array        # i32 [G] entries walked (absolute shadow index)
     w_cfg: jax.Array             # i32 [G]
@@ -199,15 +230,30 @@ class ShardKvState(NamedTuple):
     w_clerk_acked: jax.Array     # i32 [NC] walker-accepted seq per client
     installs_done: jax.Array     # i32 scalar: INSTALL entries walked
     deletes_done: jax.Array      # i32 scalar: DELETE entries walked
+    max_cfg_lag: jax.Array       # i32 scalar: max configs a restarting node
+    #                              had missed (miss_change_4b coverage signal)
     # --- deployment-level violations (group raft violations live in rafts) ---
     violations: jax.Array        # i32 scalar sticky bitmask
     first_violation_tick: jax.Array
 
 
 def _gen_schedule(cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array):
-    """Config schedule: activation ticks + owner maps. Config 0 is round-robin
-    at tick 0; each later config moves one random shard to a random group
-    (the join/leave churn of tests.rs:193-362, as data)."""
+    """Config schedule: activation ticks + owner maps, as Join/Leave churn.
+
+    Config 0 assigns shards round-robin over all groups. Each later config is
+    a Join (a departed group re-enters) or a Leave (a member departs, always
+    keeping >= 1), followed by a deterministic balanced minimal-move
+    rebalance: orphaned shards go to the least-loaded member one at a time,
+    then single shards move most->least loaded until max - min <= 1. This is
+    the reference's Join/Leave semantics as data — several shards migrating
+    concurrently between several group pairs per config
+    (/root/reference/src/shard_ctrler/tester.rs:134-150 balance check,
+    /root/reference/src/shardkv/tests.rs:193-362 concurrent churn). Groups
+    that leave keep running (their raft cluster stays up, serving migration
+    pulls); membership is purely an ownership-map property, as in the
+    reference where a left group's servers still host surrendered shards
+    until GC.
+    """
     ncfg, ns, g = kcfg.n_configs, kcfg.n_shards, kcfg.n_groups
     kt, km = jax.random.split(jax.random.fold_in(key, _S_CFGGEN))
     gaps = jax.random.randint(
@@ -216,15 +262,60 @@ def _gen_schedule(cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array):
     )
     cfg_tick = jnp.cumsum(gaps) - gaps[0]  # config 0 active from tick 0
     owner0 = jnp.arange(ns, dtype=I32) % g
+    gids = jnp.arange(g, dtype=I32)
 
-    def body(owner, k):
-        ks, kg = jax.random.split(k)
-        s = jax.random.randint(ks, (), 0, ns, dtype=I32)
-        dst = jax.random.randint(kg, (), 0, g, dtype=I32)
-        nxt = jnp.where(jnp.arange(ns, dtype=I32) == s, dst, owner)
-        return nxt, nxt
+    def counts_of(owner, members):
+        c = jnp.sum(owner[None, :] == gids[:, None], axis=1).astype(I32)
+        return jnp.where(members, c, 0)
 
-    _, owners = jax.lax.scan(body, owner0, jax.random.split(km, ncfg - 1))
+    def rebalance(owner, members):
+        # orphans (owner no longer a member) -> least-loaded member, in shard
+        # order (deterministic, minimal: orphans must move anyway)
+        def orphan_body(sh, owner):
+            c = counts_of(owner, members)
+            tgt = jnp.argmin(jnp.where(members, c, _BIG)).astype(I32)
+            is_orph = ~members[owner[sh]]
+            return owner.at[sh].set(jnp.where(is_orph, tgt, owner[sh]))
+
+        owner = jax.lax.fori_loop(0, ns, orphan_body, owner)
+
+        # level: move ONE shard most->least loaded while max - min > 1
+        # (ns iterations always suffice; each no-op draw is masked out)
+        def level_body(_, owner):
+            c = counts_of(owner, members)
+            mx = jnp.argmax(jnp.where(members, c, -1)).astype(I32)
+            mn = jnp.argmin(jnp.where(members, c, _BIG)).astype(I32)
+            need = (c[mx] - c[mn]) > 1
+            ssel = jnp.argmax(owner == mx).astype(I32)
+            return owner.at[ssel].set(
+                jnp.where(need, mn, owner[ssel]).astype(I32)
+            )
+
+        return jax.lax.fori_loop(0, ns, level_body, owner)
+
+    def body(carry, k):
+        owner, members = carry
+        ke, kp = jax.random.split(k)
+        n_mem = jnp.sum(members.astype(I32))
+        can_join = n_mem < g
+        can_leave = n_mem > 1
+        do_join = can_join & (jax.random.bernoulli(ke, 0.5) | ~can_leave)
+        # the r-th element of the candidate pool (members for Leave,
+        # non-members for Join), picked by cumsum rank
+        pool = jnp.where(do_join, ~members, members)
+        r = jax.random.randint(
+            kp, (), 0, jnp.maximum(jnp.sum(pool.astype(I32)), 1), dtype=I32
+        )
+        pick = (jnp.cumsum(pool.astype(I32)) == r + 1) & pool
+        gsel = jnp.argmax(pick).astype(I32)
+        members = members.at[gsel].set(do_join)
+        owner = rebalance(owner, members)
+        return (owner, members), owner
+
+    (_, _), owners = jax.lax.scan(
+        body, (owner0, jnp.ones((g,), jnp.bool_)),
+        jax.random.split(km, ncfg - 1),
+    )
     cfg_owner = jnp.concatenate([owner0[None], owners], axis=0)
     return cfg_tick, cfg_owner
 
@@ -269,8 +360,12 @@ def init_shardkv_cluster(
         clerk_seq=jnp.zeros((nc,), I32),
         clerk_out=jnp.zeros((nc,), jnp.bool_),
         clerk_shard=jnp.zeros((nc,), I32),
+        clerk_kind=jnp.zeros((nc,), I32),
         clerk_cfg=jnp.zeros((nc,), I32),
         clerk_acked=jnp.zeros((nc,), I32),
+        clerk_get_lo=jnp.zeros((nc,), I32),
+        clerk_get_obs=jnp.full((nc,), -1, I32),
+        gets_done=jnp.zeros((nc,), I32),
         w_frontier=jnp.zeros((g,), I32),
         w_cfg=jnp.zeros((g,), I32),
         w_phase=phase0[:, 0, :],
@@ -285,6 +380,7 @@ def init_shardkv_cluster(
         w_clerk_acked=jnp.zeros((nc,), I32),
         installs_done=jnp.asarray(0, I32),
         deletes_done=jnp.asarray(0, I32),
+        max_cfg_lag=jnp.asarray(0, I32),
         violations=jnp.asarray(0, I32),
         first_violation_tick=jnp.asarray(-1, I32),
     )
@@ -326,6 +422,14 @@ def shardkv_step(
     key_hash = jnp.where(fresh[..., None], snap_hash, key_hash)
     key_count = jnp.where(fresh[..., None], snap_count, key_count)
     last_seq = jnp.where(fresh[..., None, None], snap_last_seq, last_seq)
+    # miss_change_4b coverage signal: how many config activations did a
+    # restarting node sleep through? (It recovers by replaying CONFIG entries
+    # / installing a snapshot — the max lag metric proves the scenario ran.)
+    restarted = (~pre.alive) & s.alive
+    max_cfg_lag = jnp.maximum(
+        st.max_cfg_lag,
+        jnp.max(jnp.where(restarted, active_cfg - node_cfg, 0)),
+    )
 
     # 2. Compaction (base advanced without install): capture live tables as
     #    the persisted snapshot (they equal the state at the new base, because
@@ -364,6 +468,9 @@ def shardkv_step(
     lane = jnp.arange(cap, dtype=I32)[None, None, :]
     sh_lane = jnp.arange(ns, dtype=I32)
     cl_lane = jnp.arange(nc, dtype=I32)
+    cl_ids = jnp.arange(nc, dtype=I32)
+    clerk_get_obs = st.clerk_get_obs
+    gids_v = jnp.arange(g, dtype=I32)
     for _ in range(kcfg.apply_max):
         can = s.alive & (applied < s.commit)  # [G, N]
         pos = _slot(applied + 1, cap)
@@ -373,21 +480,38 @@ def shardkv_step(
         sh_oh = sh_lane[None, None, :] == shard[..., None]          # [G,N,NS]
         cl_oh = cl_lane[None, None, :] == client[..., None]          # [G,N,NC]
 
-        # APPEND: accept iff the shard is OWNED here and the seq is fresh.
+        # APPEND/GET: accept iff the shard is OWNED here and the seq is
+        # fresh; only Appends mutate, both update the dup table.
         cur_phase = jnp.sum(jnp.where(sh_oh, phase, 0), axis=-1)
         owned = cur_phase == OWNED
         prev_seq = jnp.sum(
             jnp.where(sh_oh[..., None] & cl_oh[..., None, :], last_seq, 0),
             axis=(-2, -1),
         )
-        is_app = can & (kind == _APPEND)
-        acc = is_app & owned & (seq > prev_seq)
+        is_rw = can & ((kind == _APPEND) | (kind == _GET))
+        acc_rw = is_rw & owned & (seq > prev_seq)
+        acc = acc_rw & (kind == _APPEND)
         upd = sh_oh & acc[..., None]
         key_hash = jnp.where(upd, key_hash * 1000003 + val[..., None], key_hash)
         key_count = jnp.where(upd, key_count + 1, key_count)
         last_seq = jnp.where(
-            upd[..., None] & cl_oh[..., None, :],
+            sh_oh[..., None] & acc_rw[..., None, None] & cl_oh[..., None, :],
             jnp.maximum(last_seq, seq[..., None, None]), last_seq,
+        )
+        # Get observation: the value a Get returns is the shard's accepted-
+        # append count at its log position (a pure function of the committed
+        # prefix; the first node to apply it yields the canonical reply, and
+        # inter-node agreement is covered by the walker-divergence oracle).
+        cur_count = jnp.sum(jnp.where(sh_oh, key_count, 0), axis=-1)  # [G,N]
+        get_acc = acc_rw & (kind == _GET)
+        m = (
+            get_acc[None, :, :]
+            & (client[None, :, :] == cl_ids[:, None, None])
+            & (seq[None, :, :] == st.clerk_seq[:, None, None])
+        )  # [NC, G, N]
+        cand = jnp.max(jnp.where(m, cur_count[None, :, :], -1), axis=(1, 2))
+        clerk_get_obs = jnp.where(
+            (clerk_get_obs < 0) & (cand >= 0), cand, clerk_get_obs
         )
 
         # CONFIG c+1: adopt iff it is exactly node_cfg+1 (in-order). Lost
@@ -501,23 +625,25 @@ def shardkv_step(
             jnp.where(sh_oh[..., None] & cl_oh[:, None, :], w_last_seq, 0),
             axis=(-2, -1),
         )
-        is_app = canw & (kind == _APPEND)
-        acc = is_app & (cur_phase == OWNED) & (seq > prev_seq)
+        is_rw = canw & ((kind == _APPEND) | (kind == _GET))
+        acc_rw = is_rw & (cur_phase == OWNED) & (seq > prev_seq)
+        acc = acc_rw & (kind == _APPEND)
         upd = sh_oh & acc[:, None]
         w_hash = jnp.where(upd, w_hash * 1000003 + val[:, None], w_hash)
         w_count = jnp.where(upd, w_count + 1, w_count)
         w_last_seq = jnp.where(
-            upd[..., None] & cl_oh[:, None, :],
+            sh_oh[..., None] & acc_rw[:, None, None] & cl_oh[:, None, :],
             jnp.maximum(w_last_seq, seq[:, None, None]), w_last_seq,
         )
         truth_count = truth_count + jnp.sum(
             (sh_lane[None, :] == shard[:, None]) & acc[:, None], axis=0,
             dtype=I32,
         )
-        # the walker's accept IS the service's reply: ack the clerk
+        # the walker's accept IS the service's reply: ack the clerk (both
+        # kinds; a Get additionally needs its observation, checked below)
         w_clerk_acked = jnp.maximum(
             w_clerk_acked,
-            jnp.max(jnp.where(cl_oh & acc[:, None], seq[:, None], 0), axis=0),
+            jnp.max(jnp.where(cl_oh & acc_rw[:, None], seq[:, None], 0), axis=0),
         )
 
         is_cfg = canw & (kind == _CONFIG) & (cfg_c == w_cfg + 1)
@@ -612,7 +738,7 @@ def shardkv_step(
     l_last_seq = lead_view(last_seq)  # [G, NS, NC]
 
     kp = jax.random.split(jax.random.fold_in(key, _S_PULL), 4)
-    knet = jax.random.split(jax.random.fold_in(key, _S_NET_PULL), 3)
+    knet = jax.random.split(jax.random.fold_in(key, _S_NET_PULL), 5)
 
     # Deliver pull requests: src leader answers for FROZEN shards at the
     # requested config with its own (frozen) state.
@@ -702,19 +828,44 @@ def shardkv_step(
     # (c) acks for shards owned in the current config that were migrated in
     #     (previous owner differs): idempotent retries; DELETE guards dedup.
     migrated_in = (l_phase == OWNED) & (prev_owner_l != my_gv[:, None])
-    ack_draw = jax.random.bernoulli(kp[3], kcfg.p_pull, (g, ns))
+    ack_draw = jax.random.bernoulli(kp[3], kcfg.p_ack, (g, ns))
     do_ack = migrated_in & ack_draw & lead_any[:, None]
-    send_ack = do_ack[:, None, :] & tgt_oh  # to previous owner, reliable-ish
-    ack_t = jnp.where(send_ack.transpose(1, 0, 2), t + 1, ack_t)
-    ack_cfg = jnp.where(
-        send_ack.transpose(1, 0, 2), l_cfg[None, :, None], st.ack_cfg
+    # acks ride the same adversarial network as pulls: lossy + 1..3-tick
+    # delays (idempotent retries; the DELETE apply guard dedups), so the GC
+    # path sees reordering too (shardkv/tests.rs:438-493 under unreliable)
+    delay3 = jax.random.randint(
+        knet[3], (g, g, ns), kcfg.pull_delay_min, kcfg.pull_delay_max + 1,
+        dtype=I32,
     )
+    lost3 = jax.random.bernoulli(knet[4], kcfg.pull_loss, (g, g, ns))
+    send_ack = (do_ack[:, None, :] & tgt_oh).transpose(1, 0, 2) & ~lost3
+    ack_t = jnp.where(send_ack, t + delay3, ack_t)
+    ack_cfg = jnp.where(send_ack, l_cfg[None, :, None], st.ack_cfg)
 
     # --------------------------------------------------------------- clerks
-    kc = jax.random.split(jax.random.fold_in(key, _S_CLERK), 5)
-    newly = st.clerk_out & (w_clerk_acked >= st.clerk_seq)
+    kc = jax.random.split(jax.random.fold_in(key, _S_CLERK), 6)
+    sh_oh_c = sh_lane[None, :] == st.clerk_shard[:, None]  # [NC, NS]
+    truth_at = jnp.sum(jnp.where(sh_oh_c, truth_count[None, :], 0), axis=1)
+    is_get_c = st.clerk_kind == _GET
+    newly = (
+        st.clerk_out & (w_clerk_acked >= st.clerk_seq)
+        & (~is_get_c | (clerk_get_obs >= 0))
+    )
+    # Reads linearizability across migration: the observed accepted-append
+    # count must lie in the op's [invoke, return] truth window (exact for
+    # count registers — kv.py KvState docstring; the freeze/install protocol
+    # makes the count well-defined across the shard's migration chain).
+    done_get = newly & is_get_c
+    viol |= jnp.where(
+        jnp.any(
+            done_get
+            & ((clerk_get_obs < st.clerk_get_lo) | (clerk_get_obs > truth_at))
+        ),
+        VIOLATION_SHARD_STALE_READ, 0,
+    )
     clerk_acked = jnp.where(newly, st.clerk_seq, st.clerk_acked)
     clerk_out = st.clerk_out & ~newly
+    gets_done = st.gets_done + done_get.astype(I32)
     learn = jax.random.bernoulli(kc[0], kcfg.p_cfg_learn, (nc,))
     clerk_cfg = jnp.where(
         learn, active_cfg, st.clerk_cfg
@@ -729,6 +880,18 @@ def shardkv_step(
         start, jax.random.randint(kc[2], (nc,), 0, ns, dtype=I32),
         st.clerk_shard,
     )
+    clerk_kind = jnp.where(
+        start,
+        jnp.where(
+            jax.random.bernoulli(kc[5], kcfg.p_get, (nc,)), _GET, _APPEND
+        ),
+        st.clerk_kind,
+    )
+    # a fresh Get captures its invoke-time truth; its observation resets
+    sh_oh_new = sh_lane[None, :] == clerk_shard[:, None]
+    truth_at_new = jnp.sum(jnp.where(sh_oh_new, truth_count[None, :], 0), axis=1)
+    clerk_get_lo = jnp.where(start, truth_at_new, st.clerk_get_lo)
+    clerk_get_obs = jnp.where(start, -1, clerk_get_obs)
     clerk_out = clerk_out | start
     retry = clerk_out & (start | jax.random.bernoulli(kc[3], kcfg.p_retry, (nc,)))
     tgt_node = jax.random.randint(kc[4], (nc,), 0, n, dtype=I32)
@@ -739,8 +902,14 @@ def shardkv_step(
     def append_at(mask_gn, value_gn, log_term, log_val, log_len):
         """Append value at nodes where mask (leader-gated by caller). Room is
         re-derived from the running log_len — several appends can land at one
-        node in one tick."""
-        ok = mask_gn & (log_len - s.base < cap) & s.alive
+        node in one tick. The flow-control gate (config.py uncommitted_cap)
+        bounds the uncommitted backlog so retry spam can never wedge the ring
+        against the current-term commit rule."""
+        ok = (
+            mask_gn & s.alive
+            & (log_len - s.base < cap)
+            & (log_len - s.commit < cfg.flow_cap)
+        )
         hit = ok[..., None] & (
             jnp.arange(cap, dtype=I32)[None, None, :]
             == _slot(log_len + 1, cap)[..., None]
@@ -773,20 +942,46 @@ def shardkv_step(
             ln_oh & ack_del[:, sh:sh + 1] & is_lead, v,
             log_term, log_val, log_len,
         )
+    # Bug mode: the contacted node skips the ownership check for reads and
+    # serves a Get on a non-OWNED shard immediately from whatever local copy
+    # it has — a FROZEN surrendered copy (missing every append the new owner
+    # accepted since the freeze) or nothing at all after GC. The interval
+    # oracle must flag any observation below the invoke-time truth.
+    owner_of = st.cfg_owner[jnp.clip(clerk_cfg, 0, kcfg.n_configs - 1)]  # [NC, NS]
+    grp_c = jnp.sum(jnp.where(sh_oh_new, owner_of, 0), axis=1)  # [NC]
+    if kcfg.bug_serve_frozen:
+        sel4 = (
+            (gids_v[None, :, None, None] == grp_c[:, None, None, None])
+            & (me_n[None, None, :, None] == tgt_node[:, None, None, None])
+            & (sh_lane[None, None, None, :] == clerk_shard[:, None, None, None])
+        )  # [NC, G, N, NS]
+        ph_at = jnp.sum(jnp.where(sel4, phase[None], 0), axis=(1, 2, 3))
+        cnt_at = jnp.sum(jnp.where(sel4, key_count[None], 0), axis=(1, 2, 3))
+        alive_at = jnp.any(jnp.any(sel4, axis=-1) & s.alive[None], axis=(1, 2))
+        served = (
+            retry & ~start & (clerk_kind == _GET) & alive_at & (ph_at != OWNED)
+        )
+        viol |= jnp.where(
+            jnp.any(
+                served & ((cnt_at < clerk_get_lo) | (cnt_at > truth_at_new))
+            ),
+            VIOLATION_SHARD_STALE_READ, 0,
+        )
+        clerk_acked = jnp.where(served, clerk_seq, clerk_acked)
+        clerk_out = clerk_out & ~served
+        gets_done = gets_done + served.astype(I32)
+        retry = retry & ~served
+
     # Client ops at the believed owner's targeted node (leader-gated; a wrong
     # or stale guess commits nothing or a rejected entry — the clerk retries).
-    owner_of = st.cfg_owner[jnp.clip(clerk_cfg, 0, kcfg.n_configs - 1)]  # [NC, NS]
     for c in range(nc):
-        shard_c = clerk_shard[c]
-        grp = jnp.sum(
-            jnp.where(sh_lane == shard_c, owner_of[c], 0)
-        )  # owner group per clerk's believed config
         sel = (
-            (jnp.arange(g, dtype=I32)[:, None] == grp)
+            (gids_v[:, None] == grp_c[c])
             & (me_n[None, :] == tgt_node[c])
             & is_lead
         )
-        v = _pack_append(kcfg, jnp.asarray(c, I32), clerk_seq[c], shard_c)
+        v = _pack_op(kcfg, jnp.asarray(c, I32), clerk_seq[c], clerk_shard[c],
+                     clerk_kind[c])
         log_term, log_val, log_len = append_at(
             sel & retry[c], jnp.broadcast_to(v, (g, n)),
             log_term, log_val, log_len,
@@ -817,14 +1012,17 @@ def shardkv_step(
         pull_rsp_last_seq=pull_rsp_last_seq,
         ack_t=ack_t, ack_cfg=ack_cfg,
         clerk_seq=clerk_seq, clerk_out=clerk_out,
-        clerk_shard=clerk_shard, clerk_cfg=clerk_cfg,
+        clerk_shard=clerk_shard, clerk_kind=clerk_kind, clerk_cfg=clerk_cfg,
         clerk_acked=clerk_acked,
+        clerk_get_lo=clerk_get_lo, clerk_get_obs=clerk_get_obs,
+        gets_done=gets_done,
         w_frontier=w_frontier, w_cfg=w_cfg, w_phase=w_phase,
         w_hash=w_hash, w_count=w_count, w_last_seq=w_last_seq,
         frz_cfg=frz_cfg, frz_hash=frz_hash,
         frz_count=frz_count, frz_last_seq=frz_last_seq,
         truth_count=truth_count, w_clerk_acked=w_clerk_acked,
         installs_done=installs_done, deletes_done=deletes_done,
+        max_cfg_lag=max_cfg_lag,
         violations=violations, first_violation_tick=first_violation_tick,
     )
 
@@ -835,11 +1033,13 @@ class ShardKvFuzzReport(NamedTuple):
     raft_violations: np.ndarray       # OR over the deployment's groups
     first_violation_tick: np.ndarray
     acked_ops: np.ndarray
+    acked_gets: np.ndarray            # completed Gets (read-path workload)
     installs: np.ndarray              # completed shard migrations
     deletes: np.ndarray               # completed shard GCs
     final_cfg: np.ndarray             # min walker config across groups
     owned_copies: np.ndarray          # per-deployment max owners of any shard
     frozen_left: np.ndarray           # frozen copies remaining at the end
+    max_cfg_lag: np.ndarray           # max configs a restarting node missed
 
     @property
     def n_violating(self) -> int:
@@ -880,7 +1080,9 @@ def make_shardkv_fuzz_fn(
         final, _ = jax.lax.scan(body, states, None, length=n_ticks)
         return final
 
-    return jax.jit(run)
+    prog = jax.jit(run)
+    # uint32 coercion: keep the (seed, cluster_id) replay contract under x64
+    return lambda seed: prog(jnp.asarray(seed, jnp.uint32))
 
 
 def shardkv_report(final: ShardKvState) -> ShardKvFuzzReport:
@@ -897,11 +1099,13 @@ def shardkv_report(final: ShardKvState) -> ShardKvFuzzReport:
         ),
         first_violation_tick=np.asarray(final.first_violation_tick),
         acked_ops=np.asarray(final.clerk_acked.sum(axis=-1)),
+        acked_gets=np.asarray(final.gets_done.sum(axis=-1)),
         installs=np.asarray(final.installs_done),
         deletes=np.asarray(final.deletes_done),
         final_cfg=np.asarray(final.w_cfg.min(axis=-1)),
         owned_copies=owned.max(axis=-1),
         frozen_left=frozen.sum(axis=-1),
+        max_cfg_lag=np.asarray(final.max_cfg_lag),
     )
 
 

@@ -53,6 +53,7 @@ from madraft_tpu.tpusim.config import (
     CANDIDATE,
     FOLLOWER,
     LEADER,
+    NOOP_CMD,
     SimConfig,
     VIOLATION_COMMIT_SHADOW,
     VIOLATION_DUAL_LEADER,
@@ -113,9 +114,10 @@ class _DrawBlock:
 
 
 def _block_total(n: int) -> int:
-    # faults 4n+1, three timer resets 3n, rv/ae response nets 4n, election
-    # timers n, client n, three [n,n] send nets with (delay, lost) each
-    return 13 * n + 1 + 6 * n * n
+    # faults 4n+3 (crash/restart/colors/restart-timers + u_part + asym pair),
+    # three timer resets 3n, rv/ae response nets 4n, election timers n,
+    # client n, three [n,n] send nets with (delay, lost) each
+    return 13 * n + 3 + 6 * n * n
 
 
 def _timeout_draw(kn, blk: "_DrawBlock", shape) -> jax.Array:
@@ -203,14 +205,43 @@ def step_cluster(
     next_idx = jnp.where(restart[:, None], 1, s.next_idx)
     match_idx = jnp.where(restart[:, None], 0, s.match_idx)
 
-    # Partition schedule: random 2-coloring / heal (connect2/disconnect2 masks,
-    # /root/reference/src/kvraft/tester.rs:88-124).
+    # Partition schedule, one mutually-exclusive event per tick drawn from a
+    # single uniform: random symmetric 2-coloring (connect2/disconnect2
+    # masks, /root/reference/src/kvraft/tester.rs:88-124), full heal,
+    # leader-in-minority partition (the current leader plus its successor
+    # against the rest — tester.rs:184-191's targeted cut), or an
+    # ASYMMETRIC single-link cut (one directed (src -> dst) edge down; the
+    # adj tensor is [dst, src] = "messages from src reach dst", so one-sided
+    # failures the reference models via connect/disconnect are first-class).
+    # Asymmetric cuts accumulate until the next repartition/heal event.
     u_part = blk.uniform(())
     colors = blk.bern(0.5, (n,))
+    asym_dst = blk.randint(0, n, ())
+    asym_off = blk.randint(1, n, ())  # src = dst + off mod n, never == dst
     part_adj = colors[:, None] == colors[None, :]
-    do_part = u_part < kn.p_repartition
-    do_heal = (~do_part) & (u_part < kn.p_repartition + kn.p_heal)
-    adj = jnp.where(do_part, part_adj, jnp.where(do_heal, True, s.adj)) | eye
+    th1 = kn.p_repartition
+    th2 = th1 + kn.p_heal
+    th3 = th2 + kn.p_leader_part
+    th4 = th3 + kn.p_asym_cut
+    do_part = u_part < th1
+    do_heal = (~do_part) & (u_part < th2)
+    lead_pre = alive & (s.role == LEADER)
+    lid = jnp.argmax(lead_pre).astype(I32)  # first live leader (0 if none)
+    lcol = (me == lid) | (me == (lid + 1) % n)
+    lpart_adj = lcol[:, None] == lcol[None, :]
+    do_lpart = (u_part >= th2) & (u_part < th3) & jnp.any(lead_pre)
+    do_asym = (u_part >= th3) & (u_part < th4)
+    cut = (me[:, None] == asym_dst) & (me[None, :] == (asym_dst + asym_off) % n)
+    adj = (
+        jnp.where(
+            do_part, part_adj,
+            jnp.where(
+                do_heal, True,
+                jnp.where(do_lpart, lpart_adj, s.adj & ~(cut & do_asym)),
+            ),
+        )
+        | eye
+    )
 
     term, voted_for = s.term, s.voted_for
     log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
@@ -502,6 +533,19 @@ def step_cluster(
     next_idx = jnp.where(win[:, None], log_len[:, None] + 1, next_idx)
     match_idx = jnp.where(win[:, None], 0, match_idx)
     hb = jnp.where(win, 0, hb)  # announce leadership with an immediate heartbeat
+    # A fresh leader appends a current-term NO-OP, exempt from flow control:
+    # the current-term commit rule can never advance over a backlog of
+    # old-term entries, and the flow gate (config.py uncommitted_cap) blocks
+    # service proposals at exactly that moment — the no-op is the bounded,
+    # always-roomy (len - base <= flow_cap + compact_every < cap) entry that
+    # restarts commit progress. The classic Raft §8 leader no-op.
+    nop = win & (log_len - base < cap)
+    nop_hit = nop[:, None] & (
+        jnp.arange(cap, dtype=I32)[None, :] == _slot(log_len + 1, cap)[:, None]
+    )
+    log_term = jnp.where(nop_hit, term[:, None], log_term)
+    log_val = jnp.where(nop_hit, NOOP_CMD, log_val)
+    log_len = jnp.where(nop, log_len + 1, log_len)
 
     # ------------------------------------------------- timers: election timeout
     running = alive & (role != LEADER)
@@ -517,7 +561,8 @@ def step_cluster(
         log_len > base, _row_gather(log_term, _slot(log_len, cap), cap), snap_term
     )
     delay, lost = _net_draws(kn, blk, (n, n))
-    send_rv = fired[None, :] & ~eye & adj.T & ~lost  # [dst, src], link src->dst
+    send_rv = fired[None, :] & ~eye & adj & ~lost  # [dst, src]; adj[dst, src]
+    #                                               = link src->dst usable
     rv_req_t = jnp.where(send_rv, t + delay, rv_req_t)
     rv_req_term = jnp.where(send_rv, term[None, :], s.rv_req_term)
     rv_req_lli = jnp.where(send_rv, log_len[None, :], s.rv_req_lli)
@@ -525,7 +570,11 @@ def step_cluster(
 
     # --------------------------------------- client command injection at leaders
     lead = alive & (role == LEADER)
-    inject = lead & blk.bern(kn.p_client_cmd, (n,)) & (log_len - base < cap)
+    inject = (
+        lead & blk.bern(kn.p_client_cmd, (n,))
+        & (log_len - base < cap)
+        & (log_len - commit < kn.flow_cap)  # proposal backpressure (config.py)
+    )
     cmd_val = s.next_cmd * n + me + 1  # unique within the cluster, never 0
     inj_hit = inject[:, None] & (lane == _slot(log_len + 1, cap)[:, None])
     log_term = jnp.where(inj_hit, term[:, None], log_term)
@@ -559,7 +608,7 @@ def step_cluster(
     # throughput caps at ae_max/heartbeat_ticks and a hot leader's window
     # outruns its followers.
     pending = lead[None, :] & (next_idx.T <= log_len[None, :])  # [dst, src]
-    send_ae = (fire_hb[None, :] | pending) & ~eye & adj.T & ~lost & ~need_snap
+    send_ae = (fire_hb[None, :] | pending) & ~eye & adj & ~lost & ~need_snap
     ae_req_t = jnp.where(send_ae, t + delay, ae_req_t)
     ae_req_term = jnp.where(send_ae, term[None, :], s.ae_req_term)
     ae_req_prev = jnp.where(send_ae, prev_m, s.ae_req_prev)
@@ -567,7 +616,7 @@ def step_cluster(
     ae_req_n = jnp.where(send_ae, n_m, s.ae_req_n)
     ae_req_commit = jnp.where(send_ae, commit[None, :], s.ae_req_commit)
     delay_sn, lost_sn = _net_draws(kn, blk, (n, n))
-    send_sn = fire_hb[None, :] & ~eye & adj.T & ~lost_sn & need_snap
+    send_sn = fire_hb[None, :] & ~eye & adj & ~lost_sn & need_snap
     sn_req_t = jnp.where(send_sn, t + delay_sn, sn_req_t)
     sn_req_term = jnp.where(send_sn, term[None, :], s.sn_req_term)
     # advance next_idx past the snapshot on send (retried via hints if lost)

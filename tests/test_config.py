@@ -1,0 +1,60 @@
+"""Config plumbing: every SimConfig/KvConfig field must reach the compiled
+program — either as a shape-determining static_key field or as a dynamic knob
+— so that a future field can't silently get baked to its default inside the
+lru_cached shared program (the round-2 advisory trap)."""
+
+import dataclasses
+
+import pytest
+
+from madraft_tpu.tpusim.config import Knobs, SimConfig
+from madraft_tpu.tpusim.engine import _validate_knobs, make_sweep_fn
+from madraft_tpu.tpusim.kv import KvConfig, KvKnobs
+
+# Fields that are deliberately NOT part of the program: documentation-only,
+# or folded into another knob (uncommitted_cap -> flow_cap; majority_override
+# -> majority).
+SIM_DOC_ONLY = {"ms_per_tick"}
+SIM_FOLDED = {
+    "uncommitted_cap": "flow_cap",
+    "majority_override": "majority",
+    "election_timeout_min": "eto_min",
+    "election_timeout_max": "eto_max",
+}
+
+
+def test_simconfig_fields_all_reach_the_program():
+    static = {"n_nodes", "log_cap", "ae_max"}  # static_key's explicit fields
+    knob_names = set(Knobs._fields)
+    for f in dataclasses.fields(SimConfig):
+        if f.name in SIM_DOC_ONLY or f.name in static:
+            continue
+        mapped = SIM_FOLDED.get(f.name, f.name)
+        assert mapped in knob_names, (
+            f"SimConfig.{f.name} is neither static nor a knob — it would be "
+            f"silently baked to its default in the shared compiled program"
+        )
+
+
+def test_kvconfig_fields_all_reach_the_program():
+    static = {"n_clients", "n_keys", "apply_max"}  # KvConfig.static_key fields
+    knob_names = set(KvKnobs._fields)
+    for f in dataclasses.fields(KvConfig):
+        if f.name in static:
+            continue
+        assert f.name in knob_names, (
+            f"KvConfig.{f.name} is neither static nor a knob"
+        )
+
+
+def test_sweep_knob_validation_rejects_bad_ranges():
+    cfg = SimConfig()
+    bad = cfg.replace(election_timeout_min=30, election_timeout_max=15).knobs()
+    with pytest.raises(ValueError, match="election timeout"):
+        _validate_knobs(bad)
+    with pytest.raises(ValueError, match="outside"):
+        _validate_knobs(cfg.replace(loss_prob=1.5).knobs())
+    with pytest.raises(ValueError, match="election timeout"):
+        make_sweep_fn(cfg, bad, n_clusters=4, n_ticks=4)
+    # a valid sweep passes validation and builds
+    make_sweep_fn(cfg, cfg.knobs(), n_clusters=4, n_ticks=4)
