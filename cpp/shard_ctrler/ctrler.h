@@ -135,11 +135,14 @@ struct ShardInfo {
         return std::nullopt;
       }
       case CtrlOp::Kind::Move: {
-        if (op.shard >= N_SHARDS) return std::nullopt;  // reject, don't UB
-        // reject a move to a gid that never joined: downstream (shardkv)
-        // would try to pull from an owner with no servers and wedge
-        if (op.gid != 0 && !configs.back().groups.count(op.gid))
-          return std::nullopt;
+        // Rejections (out-of-range shard; a gid that never joined, which
+        // downstream shardkv would try to pull from with no servers and
+        // wedge) return the CURRENT config so callers can distinguish
+        // rejected (Some) from applied (None) — round-2 advisory: a silent
+        // drop was indistinguishable from success at the clerk API.
+        if (op.shard >= N_SHARDS ||
+            (op.gid != 0 && !configs.back().groups.count(op.gid)))
+          return configs.back();
         Config c = configs.back();
         c.num++;
         c.shards[op.shard] = op.gid;
@@ -283,13 +286,13 @@ class CtrlerClerk {
   }
   // DEVIATION from the reference (which applies Move verbatim,
   // shard_ctrler/server.rs): a Move targeting a gid that never joined is
-  // silently DROPPED — it commits through raft but produces no new config
-  // (see the apply-side guard above) because downstream shardkv would try to
-  // pull the shard from an owner with no servers and wedge. A caller that
-  // needs to distinguish applied-from-rejected should query() and compare
-  // config numbers.
-  Task<void> move_(uint64_t shard, Gid gid) {
-    return drop(core_.call(CtrlOp::move_(shard, gid)));
+  // REJECTED — it commits through raft but produces no new config, because
+  // downstream shardkv would try to pull the shard from an owner with no
+  // servers and wedge. Returns true if the move was applied, false if
+  // rejected (the apply path answers a rejection with the unchanged current
+  // config instead of None).
+  Task<bool> move_(uint64_t shard, Gid gid) {
+    return applied(core_.call(CtrlOp::move_(shard, gid)));
   }
   uint64_t id() const { return core_.id(); }
 
@@ -300,6 +303,10 @@ class CtrlerClerk {
   }
   static Task<void> drop(Task<std::optional<Config>> t) {
     co_await std::move(t);
+  }
+  static Task<bool> applied(Task<std::optional<Config>> t) {
+    auto c = co_await std::move(t);
+    co_return !c.has_value();
   }
   ClerkCore<ShardInfo> core_;
 };

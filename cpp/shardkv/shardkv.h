@@ -32,6 +32,8 @@
 #pragma once
 
 #include <array>
+#include <cstdlib>
+#include <cstring>
 #include <map>
 #include <optional>
 #include <string>
@@ -59,6 +61,28 @@ using simcore::Task;
 // mod.rs:12-15 — "please do not change it"
 inline size_t key2shard(const std::string& key) {
   return size_t(key.empty() ? 0 : uint8_t(key[0])) % N_SHARDS;
+}
+
+// Deliberate-bug injection for the TPU<->C++ differential bridge
+// (madraft_tpu/bridge.py): the TPU fuzzer finds a violation under one of its
+// service bug modes; the C++ replay runs the SAME protocol bug so its
+// client-side checkers must observe the same violation class. Env-gated so
+// the production build path is untouched.
+//   MADTPU_SHARDKV_BUG=drop_dup_table  — InstallShard discards the migrated
+//                                        dup table (exactly-once breaks
+//                                        across migration)
+//   MADTPU_SHARDKV_BUG=serve_frozen    — a leader skips the ownership check
+//                                        for reads and serves Gets from
+//                                        whatever local copy exists
+inline int bug_mode() {
+  static const int m = [] {
+    const char* e = std::getenv("MADTPU_SHARDKV_BUG");
+    if (!e) return 0;
+    if (!std::strcmp(e, "drop_dup_table")) return 1;
+    if (!std::strcmp(e, "serve_frozen")) return 2;
+    return 0;
+  }();
+  return m;
 }
 
 // msg.rs:3-8
@@ -222,6 +246,22 @@ class ShardKvServer : public std::enable_shared_from_this<ShardKvServer> {
     // WrongGroup from a stale follower would send the clerk back to the
     // ctrler in a loop. Followers answer NotLeader (via start()) instead.
     size_t shard = key2shard(req.op.key);
+    if (bug_mode() == 2 && self->raft_->is_leader() &&
+        !self->serving(shard) && req.op.kind == Op::Kind::Get) {
+      // BUG (bridge validation): serve the read from whatever local copy
+      // exists — the latest frozen outgoing copy, or nothing after GC
+      const ShardData* src = &self->shards_[shard];
+      for (auto it = self->outgoing_.rbegin(); it != self->outgoing_.rend();
+           ++it) {
+        if (it->first.second == shard) {
+          src = &it->second;
+          break;
+        }
+      }
+      auto kv = src->kv.find(req.op.key);
+      co_return KvReply{Code::Ok, -1,
+                        kv == src->kv.end() ? std::string() : kv->second};
+    }
     if (self->raft_->is_leader() && !self->serving(shard))
       co_return KvReply{Code::WrongGroup};
     Enc e;
@@ -494,6 +534,7 @@ class ShardKvServer : public std::enable_shared_from_this<ShardKvServer> {
           break;  // duplicate install
         Dec sd(data);
         shards_[shard] = ShardData::dec(sd);
+        if (bug_mode() == 1) shards_[shard].dup.clear();  // BUG: see bug_mode()
         MT_LOG("shardkv", "gid %llu installs shard %llu at config %llu",
                (unsigned long long)gid_, (unsigned long long)shard,
                (unsigned long long)cfg_num);

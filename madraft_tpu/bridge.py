@@ -40,7 +40,7 @@ from madraft_tpu.tpusim.config import (
     VIOLATION_LOG_MATCHING,
 )
 from madraft_tpu.tpusim.state import init_cluster
-from madraft_tpu.tpusim.step import _lane_abs, step_cluster
+from madraft_tpu.tpusim.step import _slot, step_cluster
 
 _REPO = pathlib.Path(__file__).resolve().parent.parent
 DEFAULT_BINARY = _REPO / "build" / "madtpu_replay"
@@ -183,8 +183,10 @@ def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
     same committed order, observing count k is exactly observing the
     concatenation of the first k committed appends to that key (in shadow
     order), so each Get's output becomes that prefix string and each Append's
-    input its unique token. Requires the run to stay within one shadow window
-    (committed entries <= log_cap) so the full order is recoverable.
+    input its unique token. The committed order is STREAMED from the per-tick
+    shadow trace (each tick's newly-committed lanes are read while still in
+    window), so the export works for runs of arbitrary length — far past one
+    shadow window of ``log_cap`` entries (the round-2 limitation).
 
     Returns (lines, violations): the history file body and the cluster's
     violation bitmask.
@@ -199,39 +201,41 @@ def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
         def body(carry, _):
             nxt = kv_step(cfg, kcfg, carry, key)
             return nxt, (nxt.clerk_seq, nxt.clerk_out, nxt.clerk_kind,
-                         nxt.clerk_key, nxt.clerk_acked, nxt.clerk_last_obs)
+                         nxt.clerk_key, nxt.clerk_acked, nxt.clerk_last_obs,
+                         nxt.raft.shadow_len, nxt.raft.shadow_val)
 
         final, trace = jax.lax.scan(
             body, init_kv_cluster(cfg, kcfg, key), None, length=n_ticks
         )
         return final, trace
 
-    final, (seq_t, out_t, kind_t, key_t, acked_t, obs_t) = jax.block_until_ready(
-        run(ckey)
+    final, (seq_t, out_t, kind_t, key_t, acked_t, obs_t, sh_len_t, sh_val_t) = (
+        jax.block_until_ready(run(ckey))
     )
     seq_t, out_t, kind_t = np.asarray(seq_t), np.asarray(out_t), np.asarray(kind_t)
     key_t, acked_t, obs_t = np.asarray(key_t), np.asarray(acked_t), np.asarray(obs_t)
+    sh_len_t, sh_val_t = np.asarray(sh_len_t), np.asarray(sh_val_t)
 
-    # committed append order per key, deduped, from the final shadow window
-    sh_val = np.asarray(final.raft.shadow_val)
-    sh_base = int(final.raft.shadow_base)
-    sh_len = int(final.raft.shadow_len)
-    assert sh_len - 0 <= sh_val.shape[0], "history outgrew the shadow window"
-    cap = sh_val.shape[0]
-    # one source of truth for the ring math (step.py)
-    lane_abs = np.asarray(_lane_abs(jnp.asarray(sh_base, jnp.int32), cap))
-    order = np.argsort(lane_abs)
+    # committed append order per key, deduped, streamed from the shadow trace:
+    # entries committed at tick ti occupy absolute indices
+    # (len[ti-1], len[ti]] and their canonical lanes ((a-1) mod cap, step.py)
+    # are still live in that tick's window, so reading them tick by tick
+    # reconstructs the full order no matter how far the window slid since.
+    cap = sh_val_t.shape[1]
     appends_by_key: dict[int, list[str]] = {}
     seen = set()
-    for lane in order:
-        if not (0 < lane_abs[lane] <= sh_len):
-            continue
-        val = int(sh_val[lane])
-        c, s, k, kind = _unpack(kcfg, val)
-        if kind != _APPEND or val in seen:
-            continue
-        seen.add(val)
-        appends_by_key.setdefault(int(k), []).append(f"a{int(c)}.{int(s)};")
+    seen_len = 0
+    for ti in range(sh_len_t.shape[0]):
+        ln = int(sh_len_t[ti])
+        for a in range(seen_len + 1, ln + 1):
+            # one source of truth for the ring-lane math (step.py)
+            val = int(sh_val_t[ti][int(_slot(a, cap))])
+            c, s, k, kind = _unpack(kcfg, val)
+            if kind != _APPEND or val in seen:
+                continue
+            seen.add(val)
+            appends_by_key.setdefault(int(k), []).append(f"a{int(c)}.{int(s)};")
+        seen_len = max(seen_len, ln)
 
     nc = kcfg.n_clients
     lines = []
@@ -300,5 +304,147 @@ def classes_match(tpu_violations: int, cpp_report: dict) -> bool:
     if tpu_violations & (VIOLATION_LOG_MATCHING | VIOLATION_COMMIT_SHADOW) and (
         cpp_report["commit_mismatch"] or cpp_report["apply_disorder"]
     ):
+        return True
+    return False
+
+
+# --------------------------------------------------------------- shardkv leg
+@dataclasses.dataclass
+class ShardKvSchedule:
+    """One shardkv deployment's config + fault schedule for the C++ replayer
+    (cpp/tools/shardkv_replay_main.cpp). The TPU controller is a pre-drawn
+    owner-map schedule; the C++ side reproduces each map through the real
+    ctrler service (Move ops) so every group chains through the same
+    reconfiguration pressure with the full pull/install/ack protocol."""
+
+    n_groups: int
+    n_nodes: int
+    ms_per_tick: int
+    n_ticks: int
+    seed: int
+    bug: str = "none"  # none | drop_dup_table | serve_frozen
+    cfg_events: list[tuple[int, list[int]]] = dataclasses.field(
+        default_factory=list
+    )  # (activation tick, owner group per shard)
+    alive_events: list[tuple[int, int, int]] = dataclasses.field(
+        default_factory=list
+    )  # (tick, group, bitmask)
+    violations: int = 0
+    first_violation_tick: int = -1
+
+    def dumps(self) -> str:
+        lines = [
+            "# madtpu shardkv differential-replay schedule (bridge.py)",
+            f"groups {self.n_groups}",
+            f"nodes {self.n_nodes}",
+            f"ticks {self.n_ticks}",
+            f"ms_per_tick {self.ms_per_tick}",
+            f"seed {self.seed}",
+            f"bug {self.bug}",
+        ]
+        for t, owners in self.cfg_events:
+            lines.append(f"cfg {t} " + " ".join(str(o) for o in owners))
+        for t, g, m in self.alive_events:
+            lines.append(f"ev {t} alive {g} {m:x}")
+        return "\n".join(lines) + "\n"
+
+
+def extract_shardkv_schedule(cfg, kcfg, seed: int, cluster_id: int,
+                             n_ticks: int) -> ShardKvSchedule:
+    """Re-run ONE shardkv deployment and record its config schedule + the
+    per-group-node fault schedule (the counterpart of extract_schedule for
+    the sharded stack)."""
+    from madraft_tpu.tpusim.shardkv import init_shardkv_cluster, shardkv_step
+
+    ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
+
+    @jax.jit
+    def run(key):
+        def body(carry, _):
+            nxt = shardkv_step(cfg, kcfg, carry, key)
+            return nxt, nxt.rafts.alive
+
+        final, alives = jax.lax.scan(
+            body, init_shardkv_cluster(cfg, kcfg, key), None, length=n_ticks
+        )
+        return final, alives
+
+    final, alives = jax.block_until_ready(run(ckey))
+    alives = np.asarray(alives)  # [T, G, N]
+    sched = ShardKvSchedule(
+        n_groups=kcfg.n_groups,
+        n_nodes=cfg.n_nodes,
+        ms_per_tick=cfg.ms_per_tick,
+        n_ticks=n_ticks,
+        seed=seed,
+        bug=(
+            "drop_dup_table" if kcfg.bug_drop_dup_table
+            else "serve_frozen" if kcfg.bug_serve_frozen
+            else "none"
+        ),
+    )
+    cfg_tick = np.asarray(final.cfg_tick)
+    cfg_owner = np.asarray(final.cfg_owner)
+    for i in range(cfg_tick.shape[0]):
+        t = int(cfg_tick[i])
+        if t >= n_ticks:
+            continue
+        sched.cfg_events.append((t, [int(o) for o in cfg_owner[i]]))
+    prev = [(1 << cfg.n_nodes) - 1] * kcfg.n_groups
+    for t in range(1, n_ticks + 1):
+        for g in range(kcfg.n_groups):
+            m = _bitmask(alives[t - 1, g])
+            if m != prev[g]:
+                sched.alive_events.append((t, g, m))
+                prev[g] = m
+    viol = int(final.violations)
+    for v in np.asarray(final.rafts.violations).ravel():
+        viol |= int(v)
+    sched.violations = viol
+    sched.first_violation_tick = int(final.first_violation_tick)
+    return sched
+
+
+def replay_shardkv_on_simcore(
+    schedule: ShardKvSchedule,
+    binary: Optional[pathlib.Path] = None,
+    workdir: Optional[pathlib.Path] = None,
+) -> dict:
+    """Run the C++ shardkv replayer on a schedule; returns its JSON report.
+    The bug mode rides in the schedule file; the binary sets the env-gated
+    injection (shardkv.h bug_mode()) itself."""
+    binary = pathlib.Path(binary or _REPO / "build" / "madtpu_shardkv_replay")
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".txt", prefix="madtpu_skv_replay_",
+        dir=str(workdir) if workdir else None, delete=False,
+    ) as f:
+        f.write(schedule.dumps())
+        path = f.name
+    try:
+        proc = subprocess.run(
+            [str(binary), path], capture_output=True, text=True, timeout=300
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"shardkv replay failed rc={proc.returncode}: "
+                f"{proc.stderr[-2000:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    finally:
+        os.unlink(path)
+
+
+def shardkv_classes_match(tpu_violations: int, cpp_report: dict) -> bool:
+    """Class map for the sharded stack: the TPU walker-divergence bit (the
+    exactly-once-across-migration oracle) corresponds to the C++ client-side
+    dup_apply flag; the TPU interval-oracle bit to stale_read."""
+    from madraft_tpu.tpusim.shardkv import (
+        VIOLATION_SHARD_DIVERGE,
+        VIOLATION_SHARD_STALE_READ,
+    )
+
+    if tpu_violations & VIOLATION_SHARD_DIVERGE and cpp_report["dup_apply"]:
+        return True
+    if tpu_violations & VIOLATION_SHARD_STALE_READ and cpp_report["stale_read"]:
         return True
     return False

@@ -149,9 +149,12 @@ class SimConfig:
     def static_key(self) -> "SimConfig":
         """Canonical config carrying only the fields that shape the compiled
         program (everything else rides in ``Knobs``). Two configs with equal
-        static_key share one XLA program."""
+        static_key share one XLA program. Dynamic fields are pinned to fixed
+        safe values (they never reach the program; compact_every=1 keeps the
+        flow/compaction margin check satisfiable at any log_cap)."""
         return SimConfig(
-            n_nodes=self.n_nodes, log_cap=self.log_cap, ae_max=self.ae_max
+            n_nodes=self.n_nodes, log_cap=self.log_cap, ae_max=self.ae_max,
+            compact_every=1,
         )
 
 

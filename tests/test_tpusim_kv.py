@@ -30,6 +30,7 @@ BASE = SimConfig(
     p_repartition=0.02,
     p_heal=0.05,
     log_cap=32,
+    compact_every=8,  # flow_cap (16) + compact_every must stay below log_cap
 )
 KV = KvConfig()
 
