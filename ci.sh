@@ -3,7 +3,7 @@
 # Mirrors the reference's CI split (/root/reference/.github/workflows/ci.yml:11-43
 # build+lint job, test.yml:20-26 test job) for this framework's two backends:
 #
-#   1. C++ build (Release) + full 69-test suite on 2 seeds
+#   1. C++ build (Release) + full 70-test suite on 2 seeds
 #   2. C++ determinism double-run (trace-hash compare; the madsim
 #      MADSIM_TEST_CHECK_DETERMINISTIC analogue, reference README.md:42-87)
 #   3. C++ ASan build + suite (memory safety for the coroutine runtime)

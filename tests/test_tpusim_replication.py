@@ -75,6 +75,29 @@ def test_leader_targeted_and_asymmetric_cuts():
     assert (rep.committed >= 3).all(), "progress must survive targeted cuts"
 
 
+def test_heterogeneous_fault_sweep():
+    # make_sweep_fn: one compiled program fuzzes a GRID of fault intensities
+    # across the cluster batch (the TPU-idiomatic inversion of the
+    # reference's compile-time test matrix). The per-cluster knobs must
+    # actually bind: lossless clusters commit far more than heavy-loss ones.
+    from madraft_tpu.tpusim.engine import make_sweep_fn, report
+
+    cfg = SimConfig(n_nodes=5, p_client_cmd=0.2)
+    n = 64
+    loss = jnp.where(jnp.arange(n) < n // 2, 0.0, 0.6).astype(jnp.float32)
+    knobs = cfg.knobs()._replace(loss_prob=loss)
+    fn = make_sweep_fn(cfg, knobs, n_clusters=n, n_ticks=384)
+    rep = report(fn(3))
+    assert rep.n_violating == 0
+    clean = rep.committed[: n // 2].mean()
+    lossy = rep.committed[n // 2:].mean()
+    assert clean > 1.5 * lossy, (
+        f"per-cluster loss knob did not bind: clean={clean} lossy={lossy}"
+    )
+    # the lossy half also pays for its elections (delivered-message account)
+    assert rep.msg_count[: n // 2].mean() > 1.5 * rep.msg_count[n // 2:].mean()
+
+
 def test_agreement_rpc_budget():
     # count_2b's agreement budget (tests.rs:461-476), batched: on a quiet
     # reliable net, total delivered messages stay within an elections +
