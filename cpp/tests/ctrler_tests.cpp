@@ -117,6 +117,22 @@ Task<void> basic_main(Sim* sim) {
     for (size_t i = 0; i < N_SHARDS; i++)
       MT_ASSERT_EQ(cf2.shards[i], (i < N_SHARDS / 2 ? 503u : 504u));
   }
+  // Move rejection is SURFACED, not success-shaped (round-2 advisory): a
+  // move to a never-joined gid reports rejected, changes no config, and a
+  // valid move reports applied.
+  {
+    Config before = co_await ck.query();
+    bool ok = co_await ck.move_(0, 999);  // gid 999 never joined
+    MT_ASSERT(!ok);
+    Config after = co_await ck.query();
+    MT_ASSERT_EQ(after.num, before.num);
+    MT_ASSERT(after == before);
+    bool ok2 = co_await ck.move_(0, 504);
+    MT_ASSERT(ok2);
+    Config after2 = co_await ck.query();
+    MT_ASSERT_EQ(after2.shards[0], 504u);
+  }
+  co_await ck.move_(0, 503);  // restore for the checks below
   co_await ck.leave(gidv(503));
   co_await ck.leave(gidv(504));
 

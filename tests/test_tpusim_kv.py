@@ -48,6 +48,24 @@ def test_kv_fuzz_clean():
     assert rep.acked_gets.sum() > 96, "Get ops must flow and complete"
 
 
+def test_kv_leader_targeted_cuts():
+    """The service stack under LEADER-TARGETED minority partitions and
+    asymmetric one-sided link cuts (the kvraft tester's leader-in-minority
+    scenario, tester.rs:184-191): a deposed-but-unaware leader keeps
+    accepting clerk ops that must be superseded without breaking
+    exactly-once or reads linearizability."""
+    cfg = BASE.replace(
+        p_repartition=0.0, p_leader_part=0.03, p_asym_cut=0.05, p_heal=0.06,
+    )
+    rep = kv_fuzz(cfg, KV.replace(p_get=0.4), seed=13, n_clusters=96,
+                  n_ticks=384)
+    assert rep.n_violating == 0, (
+        f"violations {rep.violations[rep.violating_clusters()[:8]]}"
+    )
+    assert (rep.acked_ops > 0).mean() > 0.9
+    assert rep.acked_gets.sum() > 96
+
+
 def test_kv_dedup_oracle_fires():
     """Applying duplicates blindly must trip the exactly-once oracle: clerk
     retries create duplicate log entries, and the dup table is the only thing
