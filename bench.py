@@ -189,6 +189,13 @@ def bench_shardkv(n_deployments: int, n_ticks: int) -> dict:
 
 
 def main() -> None:
+    # MADTPU_BENCH_PLATFORM=cpu forces the CPU backend (ci.sh fallback when
+    # no healthy accelerator is attached); must run before backend init
+    import os
+
+    plat = os.environ.get("MADTPU_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     n_clusters = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
     n_ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
     raft = bench_raft(n_clusters, n_ticks, flagship_config())

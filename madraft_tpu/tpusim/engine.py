@@ -115,6 +115,11 @@ def _validate_knobs(knobs) -> None:
         raise ValueError(f"election timeout span empty: [{k.eto_min}, {k.eto_max}]")
     if (k.delay_max < k.delay_min).any() or (k.delay_min < 1).any():
         raise ValueError(f"delay span empty: [{k.delay_min}, {k.delay_max}]")
+    if (k.delay_max - k.delay_min >= 256).any():
+        raise ValueError(
+            "delay span wider than 256 ticks exceeds the packed draw width "
+            "(step.py _net_draws)"
+        )
     if (k.majority < 1).any() or (k.heartbeat_ticks < 1).any():
         raise ValueError("majority and heartbeat_ticks must be >= 1")
     if (k.flow_cap < 1).any() or (k.compact_every < 1).any():

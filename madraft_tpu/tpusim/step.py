@@ -18,8 +18,12 @@ sends are computed from post-update persistent arrays, mirroring the reference's
                   starves). Raft tolerates the deferral — every delivery
                   field is cumulative — and it turns the per-source
                   sequential passes (the measured hot spot at 16k-cluster
-                  batches) into single vectorized ones. Order:
-                  install-snapshot triggers, then RV/AE requests/responses.
+                  batches) into single vectorized ones. Order: RV/AE
+                  RESPONSES first (request processing overwrites response
+                  slots, so responses must be consumed before requests or
+                  deterministic delays starve them — see the RV-responses
+                  comment), then install-snapshot triggers, then RV/AE
+                  requests.
   3. timers     — election timeouts -> candidacy + RequestVote broadcast;
                   client command injection at leaders; leader heartbeat ->
                   AppendEntries (or install-snapshot for peers behind the
@@ -95,13 +99,18 @@ class _DrawBlock:
         self.off += size
         return out
 
+    @staticmethod
+    def _u01(words):
+        """u32 words -> exact f32 uniforms in [0, 1): the draw keeps 24 bits
+        so the conversion is exact and u < 1.0 always holds — p=1.0 knobs
+        (deterministic schedules for oracle validation) fire every tick,
+        with no round-up-to-1.0 corner. Single source of the treatment for
+        bern/uniform/_net_draws."""
+        return (words >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+
     def bern(self, p, shape):
-        # p may be a traced f32 scalar (dynamic knob); compare in [0,1) space.
-        # The draw keeps 24 bits so the f32 conversion is exact and u < 1.0
-        # always holds — p=1.0 knobs (deterministic schedules for oracle
-        # validation) fire every tick, with no round-up-to-1.0 corner.
-        u = (self._take(shape) >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
-        return u < p
+        # p may be a traced f32 scalar (dynamic knob); compare in [0,1) space
+        return self._u01(self._take(shape)) < p
 
     def randint(self, lo, hi, shape):  # [lo, hi); bounds may be traced i32
         span = (jnp.asarray(hi, I32) - jnp.asarray(lo, I32)).astype(jnp.uint32)
@@ -109,15 +118,15 @@ class _DrawBlock:
                 + (self._take(shape) % span).astype(I32))
 
     def uniform(self, shape):
-        # same 24-bit treatment as bern(): values are exact in f32 and < 1.0
-        return (self._take(shape) >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+        return self._u01(self._take(shape))
 
 
 def _block_total(n: int) -> int:
     # faults 4n+3 (crash/restart/colors/restart-timers + u_part + asym pair),
-    # three timer resets 3n, rv/ae response nets 4n, election timers n,
-    # client n, three [n,n] send nets with (delay, lost) each
-    return 13 * n + 3 + 6 * n * n
+    # three timer resets 3n, rv/ae response nets 2n, election timers n,
+    # client n, three [n,n] send nets — every (delay, lost) pair packs into
+    # ONE u32 (see _net_draws), which nearly halves the threefry budget
+    return 11 * n + 3 + 3 * n * n
 
 
 def _timeout_draw(kn, blk: "_DrawBlock", shape) -> jax.Array:
@@ -125,9 +134,19 @@ def _timeout_draw(kn, blk: "_DrawBlock", shape) -> jax.Array:
 
 
 def _net_draws(kn, blk: "_DrawBlock", shape):
-    """(delay, lost) draws for a batch of sends."""
-    delay = blk.randint(kn.delay_min, kn.delay_max + 1, shape)
-    lost = blk.bern(kn.loss_prob, shape)
+    """(delay, lost) draws for a batch of sends, packed into ONE u32 per
+    send: bits 8..31 decide loss (via _u01 — exact, < 1.0), bits 0..7
+    decide the delay via modulo (bias <= span/256 for the tick-scale spans
+    here; spans wider than 256 are clamped so every value stays drawable
+    rather than silently truncating the regime). Disjoint bit ranges of one
+    threefry word are independent draws."""
+    w = blk._take(shape)
+    lost = blk._u01(w) < kn.loss_prob
+    span = jnp.clip(
+        jnp.asarray(kn.delay_max, I32) + 1 - jnp.asarray(kn.delay_min, I32),
+        1, 256,
+    ).astype(jnp.uint32)
+    delay = jnp.asarray(kn.delay_min, I32) + ((w & 0xFF) % span).astype(I32)
     return delay, lost
 
 
@@ -273,6 +292,63 @@ def step_cluster(
     def picked(pick, field):
         """field value of the picked source per dst (0 where none)."""
         return jnp.sum(jnp.where(pick, field, 0), axis=1)
+
+    # ---------------------------------------------------- deliver: RV responses
+    # RESPONSES deliver BEFORE REQUESTS on purpose: processing a request
+    # writes a fresh response into the single-slot mailbox (stamped
+    # t + delay), so with deterministic or pipelined delays a request
+    # arriving every tick would re-stamp the slot into the future every
+    # tick and the due response would NEVER be consumed — a response-
+    # starvation livelock (match_idx frozen, zero commits) that the default
+    # randomized 1..3-tick delays masked with gaps. Consuming due responses
+    # first makes the overwrite land on an already-consumed slot. (Requests
+    # don't need this: their sends happen in the timer/heartbeat phases,
+    # after all deliveries.)
+    pick, defer, due = pick_one(rv_rsp_t)
+    stale = rv_rsp_t <= t  # includes this tick's processed/dropped slots
+    rv_rsp_t = jnp.where(defer, t + 1, jnp.where(stale, 0, rv_rsp_t))
+    got = jnp.any(pick, axis=1)
+    delivered += jnp.sum(pick, dtype=I32)
+    mterm = picked(pick, rv_rsp_term)
+    higher = got & (mterm > term)
+    term = jnp.where(higher, mterm, term)
+    role = jnp.where(higher, FOLLOWER, role)
+    voted_for = jnp.where(higher, -1, voted_for)
+    accept = (
+        got & jnp.any(pick & rv_rsp_granted, axis=1)
+        & (role == CANDIDATE) & (mterm == term)
+    )
+    votes = votes | (pick & accept[:, None])
+
+    # ---------------------------------------------------- deliver: AE responses
+    pick, defer, due = pick_one(ae_rsp_t)
+    stale = ae_rsp_t <= t
+    ae_rsp_t = jnp.where(defer, t + 1, jnp.where(stale, 0, ae_rsp_t))
+    got = jnp.any(pick, axis=1)
+    delivered += jnp.sum(pick, dtype=I32)
+    mterm = picked(pick, ae_rsp_term)
+    higher = got & (mterm > term)
+    term = jnp.where(higher, mterm, term)
+    role = jnp.where(higher, FOLLOWER, role)
+    voted_for = jnp.where(higher, -1, voted_for)
+    okl = got & (role == LEADER) & (mterm == term)
+    succ_flag = jnp.any(pick & ae_rsp_success, axis=1)
+    succ = okl & succ_flag
+    fail = okl & ~succ_flag
+    m = picked(pick, ae_rsp_match)
+    match_idx = jnp.where(
+        pick & succ[:, None],
+        jnp.maximum(match_idx, m[:, None]), match_idx,
+    )
+    next_idx = jnp.where(
+        pick & succ[:, None],
+        jnp.maximum(next_idx, m[:, None] + 1),
+        jnp.where(
+            pick & fail[:, None],
+            jnp.maximum(jnp.minimum(next_idx, m[:, None] + 1), 1),
+            next_idx,
+        ),
+    )
 
     # ------------------------------------------- deliver: install-snapshot
     # Payload (boundary, snapshot term, service state) is the sender's live
@@ -483,58 +559,21 @@ def step_cluster(
     rsp_match = jnp.where(success, batch_end, hint)
     delay, lost = _net_draws(kn, blk, (n,))
     send = got & ~lost  # per follower (one response per tick)
-    resp = pick.T & send[None, :]  # slot [leader, follower]
+    # KEEP-OLDEST for periodically-regenerated messages: an occupied slot
+    # (an in-flight response, incl. deferred ones) keeps its message and the
+    # new send is dropped. With overwrite-newest, any delay span with
+    # delay_min >= 2 starves the channel permanently — each tick's fresh
+    # response re-stamps the slot into the future before its due tick ever
+    # arrives. Dropping the new send is ordinary message loss, which every
+    # consumer already tolerates; the channel then delivers one message per
+    # round trip. (RV responses stay newest-wins: vote requests are one-shot
+    # per election timeout, so they cannot starve, and a fresher term is the
+    # more adversarial payload to deliver.)
+    resp = pick.T & send[None, :] & (ae_rsp_t == 0)  # slot [leader, follower]
     ae_rsp_t = jnp.where(resp, (t + delay)[None, :], ae_rsp_t)
     ae_rsp_term = jnp.where(resp, term[None, :], ae_rsp_term)
     ae_rsp_success = jnp.where(resp, success[None, :], ae_rsp_success)
     ae_rsp_match = jnp.where(resp, rsp_match[None, :], ae_rsp_match)
-
-    # ---------------------------------------------------- deliver: RV responses
-    pick, defer, due = pick_one(rv_rsp_t)
-    stale = rv_rsp_t <= t  # includes this tick's processed/dropped slots
-    rv_rsp_t = jnp.where(defer, t + 1, jnp.where(stale, 0, rv_rsp_t))
-    got = jnp.any(pick, axis=1)
-    delivered += jnp.sum(pick, dtype=I32)
-    mterm = picked(pick, rv_rsp_term)
-    higher = got & (mterm > term)
-    term = jnp.where(higher, mterm, term)
-    role = jnp.where(higher, FOLLOWER, role)
-    voted_for = jnp.where(higher, -1, voted_for)
-    accept = (
-        got & jnp.any(pick & rv_rsp_granted, axis=1)
-        & (role == CANDIDATE) & (mterm == term)
-    )
-    votes = votes | (pick & accept[:, None])
-
-    # ---------------------------------------------------- deliver: AE responses
-    pick, defer, due = pick_one(ae_rsp_t)
-    stale = ae_rsp_t <= t
-    ae_rsp_t = jnp.where(defer, t + 1, jnp.where(stale, 0, ae_rsp_t))
-    got = jnp.any(pick, axis=1)
-    delivered += jnp.sum(pick, dtype=I32)
-    mterm = picked(pick, ae_rsp_term)
-    higher = got & (mterm > term)
-    term = jnp.where(higher, mterm, term)
-    role = jnp.where(higher, FOLLOWER, role)
-    voted_for = jnp.where(higher, -1, voted_for)
-    okl = got & (role == LEADER) & (mterm == term)
-    succ_flag = jnp.any(pick & ae_rsp_success, axis=1)
-    succ = okl & succ_flag
-    fail = okl & ~succ_flag
-    m = picked(pick, ae_rsp_match)
-    match_idx = jnp.where(
-        pick & succ[:, None],
-        jnp.maximum(match_idx, m[:, None]), match_idx,
-    )
-    next_idx = jnp.where(
-        pick & succ[:, None],
-        jnp.maximum(next_idx, m[:, None] + 1),
-        jnp.where(
-            pick & fail[:, None],
-            jnp.maximum(jnp.minimum(next_idx, m[:, None] + 1), 1),
-            next_idx,
-        ),
-    )
 
     # Candidate -> leader on majority (election win; raft.rs:286-292 drain path).
     win = alive & (role == CANDIDATE) & (jnp.sum(votes, axis=1) >= kn.majority)
@@ -617,7 +656,12 @@ def step_cluster(
     # throughput caps at ae_max/heartbeat_ticks and a hot leader's window
     # outruns its followers.
     pending = lead[None, :] & (next_idx.T <= log_len[None, :])  # [dst, src]
-    send_ae = (fire_hb[None, :] | pending) & ~eye & adj & ~lost & ~need_snap
+    # keep-oldest (see the AE-response comment): eager per-tick resends must
+    # not clobber an in-flight request or delay_min >= 2 starves the channel
+    send_ae = (
+        (fire_hb[None, :] | pending) & ~eye & adj & ~lost & ~need_snap
+        & (ae_req_t == 0)
+    )
     ae_req_t = jnp.where(send_ae, t + delay, ae_req_t)
     ae_req_term = jnp.where(send_ae, term[None, :], s.ae_req_term)
     ae_req_prev = jnp.where(send_ae, prev_m, s.ae_req_prev)
@@ -625,7 +669,9 @@ def step_cluster(
     ae_req_n = jnp.where(send_ae, n_m, s.ae_req_n)
     ae_req_commit = jnp.where(send_ae, commit[None, :], s.ae_req_commit)
     delay_sn, lost_sn = _net_draws(kn, blk, (n, n))
-    send_sn = fire_hb[None, :] & ~eye & adj & ~lost_sn & need_snap
+    send_sn = (
+        fire_hb[None, :] & ~eye & adj & ~lost_sn & need_snap & (sn_req_t == 0)
+    )
     sn_req_t = jnp.where(send_sn, t + delay_sn, sn_req_t)
     sn_req_term = jnp.where(send_sn, term[None, :], s.sn_req_term)
     # advance next_idx past the snapshot on send (retried via hints if lost)

@@ -75,6 +75,22 @@ def test_leader_targeted_and_asymmetric_cuts():
     assert (rep.committed >= 3).all(), "progress must survive targeted cuts"
 
 
+def test_liveness_across_delay_spans():
+    # Round-3 regression: deterministic (1..1) and min>=2 (2..3, 3..6) delay
+    # spans starved the single-slot mailboxes under overwrite-newest + eager
+    # resends — elections succeeded but NOTHING ever committed. Fixed by
+    # responses-before-requests delivery order plus keep-oldest slots for
+    # periodically-regenerated messages (step.py). Every span must commit.
+    base = SimConfig(n_nodes=5, p_client_cmd=0.2)
+    for dmin, dmax in ((1, 1), (2, 3), (3, 6), (1, 3)):
+        rep = fuzz(base.replace(delay_min=dmin, delay_max=dmax), seed=321,
+                   n_clusters=32, n_ticks=256)
+        assert rep.n_violating == 0
+        assert (rep.committed > 5).all(), (
+            f"delay {dmin}..{dmax} starved: committed {rep.committed.min()}"
+        )
+
+
 def test_heterogeneous_fault_sweep():
     # make_sweep_fn: one compiled program fuzzes a GRID of fault intensities
     # across the cluster batch (the TPU-idiomatic inversion of the
