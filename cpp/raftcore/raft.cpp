@@ -11,10 +11,11 @@ namespace raftcore {
 // the TPU backend's SimConfig.majority_override (madraft_tpu/tpusim/config.py)
 // so a violation class found by the batched fuzzer replays here.
 static size_t quorum(size_t n_peers) {
-  static int override_v = [] {
-    const char* e = std::getenv("MADTPU_MAJORITY_OVERRIDE");
-    return e ? std::atoi(e) : 0;
-  }();
+  // read per call, NOT cached statically: the in-process C API
+  // (cpp/tools/capi.cpp) runs many replays with different overrides in one
+  // process; getenv is cheap relative to a commit advance
+  const char* e = std::getenv("MADTPU_MAJORITY_OVERRIDE");
+  int override_v = e ? std::atoi(e) : 0;
   // clamp: an override above the cluster size would wrap the
   // peers_.size() - quorum() index in advance_commit
   return override_v > 0 ? std::min((size_t)override_v, n_peers)

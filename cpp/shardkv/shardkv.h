@@ -75,14 +75,14 @@ inline size_t key2shard(const std::string& key) {
 //                                        for reads and serves Gets from
 //                                        whatever local copy exists
 inline int bug_mode() {
-  static const int m = [] {
-    const char* e = std::getenv("MADTPU_SHARDKV_BUG");
-    if (!e) return 0;
-    if (!std::strcmp(e, "drop_dup_table")) return 1;
-    if (!std::strcmp(e, "serve_frozen")) return 2;
-    return 0;
-  }();
-  return m;
+  // read per call, NOT cached statically: the in-process C API
+  // (cpp/tools/capi.cpp) runs replays with different bug modes in one
+  // process; this is a cold path (client ops + installs)
+  const char* e = std::getenv("MADTPU_SHARDKV_BUG");
+  if (!e) return 0;
+  if (!std::strcmp(e, "drop_dup_table")) return 1;
+  if (!std::strcmp(e, "serve_frozen")) return 2;
+  return 0;
 }
 
 // msg.rs:3-8

@@ -1,0 +1,74 @@
+// libmadtpu — the in-process C API over the simcore tool suite, bound from
+// Python via ctypes (madraft_tpu/simcore.py). SURVEY.md §7 architecture
+// item 4 calls for Python<->C++ bindings for the bridge; pybind11 is not
+// available in the build image, so this is a plain C ABI:
+//
+//   int madtpu_replay_run(const char* schedule, char* out, int cap);
+//   int madtpu_shardkv_replay_run(const char* schedule, char* out, int cap);
+//   int madtpu_lincheck_run(const char* history);
+//
+// The replay entry points take the SAME schedule text the CLI binaries
+// read from files (fmemopen reuses the parsers verbatim) and write the
+// SAME one-line JSON report into `out`; return = bytes written, or
+// -1 parse error / -2 sim deadlock / -3 buffer too small.
+// madtpu_lincheck_run returns 1 linearizable / 0 not / -1 parse error.
+//
+// Each call runs a fresh simcore Sim to completion on the calling thread.
+// ALL entry points serialize behind one mutex: the replay knobs ride in
+// process-global env vars (majority override, shardkv bug mode — set and
+// RESTORED per run by an EnvGuard; the env reads in raftcore/shardkv are
+// per-call, not cached, for exactly this reason), and concurrent
+// setenv/getenv is undefined behavior in glibc. Concurrent Python threads
+// are therefore SAFE but get no parallelism — run multiple processes for
+// parallel replays.
+#include <cstring>
+#include <mutex>
+
+#include "lincheck_core.h"
+#include "replay_core.h"
+#include "shardkv_replay_core.h"
+
+namespace {
+
+std::mutex g_call_mutex;
+
+int emit(const std::string& report, char* out, int cap) {
+  if (report.empty()) return -2;
+  if ((int)report.size() + 1 > cap) return -3;
+  std::memcpy(out, report.c_str(), report.size() + 1);
+  return (int)report.size();
+}
+
+}  // namespace
+
+extern "C" {
+
+int madtpu_replay_run(const char* schedule, char* out, int cap) {
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  FILE* f = fmemopen((void*)schedule, std::strlen(schedule), "r");
+  if (!f) return -1;
+  madtpu_replay::Schedule sch;
+  bool ok = madtpu_replay::parse_schedule(f, &sch);
+  std::fclose(f);
+  if (!ok) return -1;
+  return emit(madtpu_replay::run_schedule(sch), out, cap);
+}
+
+int madtpu_shardkv_replay_run(const char* schedule, char* out, int cap) {
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  FILE* f = fmemopen((void*)schedule, std::strlen(schedule), "r");
+  if (!f) return -1;
+  madtpu_shardkv_replay::Schedule sch;
+  bool ok = madtpu_shardkv_replay::parse_schedule(f, &sch);
+  std::fclose(f);
+  if (!ok || sch.groups > madtpu_shardkv_replay::ShardKvTester::N_GROUPS)
+    return -1;
+  return emit(madtpu_shardkv_replay::run_schedule(sch), out, cap);
+}
+
+int madtpu_lincheck_run(const char* history) {
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  return madtpu_lincheck::check_history_text(history);
+}
+
+}  // extern "C"

@@ -1,0 +1,36 @@
+// Scoped set/restore of an env-riding knob (majority override, shardkv bug
+// mode). The C API serializes all calls behind one mutex (capi.cpp), so the
+// process-global env is never mutated concurrently; the guard keeps the
+// restore correct across every return path.
+#pragma once
+
+#include <cstdlib>
+#include <string>
+
+namespace madtpu_tools {
+
+struct EnvGuard {
+  const char* name;
+  std::string saved;
+  bool had;
+
+  EnvGuard(const char* n, const char* value) : name(n) {
+    const char* old = std::getenv(n);
+    had = old != nullptr;
+    if (had) saved = old;
+    if (value)
+      setenv(n, value, 1);
+    else
+      unsetenv(n);
+  }
+  ~EnvGuard() {
+    if (had)
+      setenv(name, saved.c_str(), 1);
+    else
+      unsetenv(name);
+  }
+  EnvGuard(const EnvGuard&) = delete;
+  EnvGuard& operator=(const EnvGuard&) = delete;
+};
+
+}  // namespace madtpu_tools

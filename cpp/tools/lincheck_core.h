@@ -1,0 +1,48 @@
+// Core of madtpu_lincheck (history parsing for the Wing-Gong checker),
+// shared by the CLI binary (lincheck_main.cpp) and the in-process C API
+// (capi.cpp / libmadtpu.so -> madraft_tpu/simcore.py). History format: see
+// lincheck_main.cpp.
+#pragma once
+
+#include <cstdio>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../kvraft/linearize.h"
+
+namespace madtpu_lincheck {
+
+// -> 1 linearizable, 0 not, -1 parse error
+inline int check_history_text(const std::string& text) {
+  std::vector<kvraft::HistOp> hist;
+  std::istringstream f(text);
+  std::string line;
+  while (std::getline(f, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    std::istringstream ss(line);
+    std::string tag, kind, key, value;
+    unsigned long long invoke, ret;
+    ss >> tag >> invoke >> ret >> kind >> key;
+    if (!ss || tag != "op") return -1;
+    ss >> value;  // may be absent: an empty Get output is legal
+    kvraft::HistOp h;
+    h.invoke = invoke;
+    h.ret = ret;
+    h.key = key;
+    if (kind == "get") {
+      h.kind = kvraft::Op::Kind::Get;
+      h.output = value;
+    } else if (kind == "put") {
+      h.kind = kvraft::Op::Kind::Put;
+      h.input = value;
+    } else {
+      h.kind = kvraft::Op::Kind::Append;
+      h.input = value;
+    }
+    hist.push_back(std::move(h));
+  }
+  return kvraft::check_linearizable_kv(hist) ? 1 : 0;
+}
+
+}  // namespace madtpu_lincheck

@@ -10,13 +10,11 @@
 //   op <invoke> <ret> <get|put|append> <key> <value>
 // where <value> is the Get output or the Put/Append input (no spaces).
 // Output: one line "linearizable" or "NOT-linearizable"; exit 0 either way.
-#include <cstdio>
+// Core logic lives in lincheck_core.h, shared with the in-process C API
+// (capi.cpp -> libmadtpu.so -> madraft_tpu/simcore.py).
 #include <fstream>
-#include <sstream>
-#include <string>
-#include <vector>
 
-#include "../kvraft/linearize.h"
+#include "lincheck_core.h"
 
 int main(int argc, char** argv) {
   if (argc < 2) {
@@ -28,36 +26,13 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "cannot open %s\n", argv[1]);
     return 2;
   }
-  std::vector<kvraft::HistOp> hist;
-  std::string line;
-  while (std::getline(f, line)) {  // unbounded line/value length
-    if (line.empty() || line[0] == '#') continue;
-    std::istringstream ss(line);
-    std::string tag, kind, key, value;
-    unsigned long long invoke, ret;
-    ss >> tag >> invoke >> ret >> kind >> key;
-    if (!ss || tag != "op") {
-      std::fprintf(stderr, "bad line: %s\n", line.c_str());
-      return 2;
-    }
-    ss >> value;  // may be absent: an empty Get output is legal
-    kvraft::HistOp h;
-    h.invoke = invoke;
-    h.ret = ret;
-    h.key = key;
-    if (kind == "get") {
-      h.kind = kvraft::Op::Kind::Get;
-      h.output = value;
-    } else if (kind == "put") {
-      h.kind = kvraft::Op::Kind::Put;
-      h.input = value;
-    } else {
-      h.kind = kvraft::Op::Kind::Append;
-      h.input = value;
-    }
-    hist.push_back(std::move(h));
+  std::string text((std::istreambuf_iterator<char>(f)),
+                   std::istreambuf_iterator<char>());
+  int r = madtpu_lincheck::check_history_text(text);
+  if (r < 0) {
+    std::fprintf(stderr, "bad history file: %s\n", argv[1]);
+    return 2;
   }
-  bool ok = kvraft::check_linearizable_kv(hist);
-  std::printf(ok ? "linearizable\n" : "NOT-linearizable\n");
+  std::printf(r ? "linearizable\n" : "NOT-linearizable\n");
   return 0;
 }

@@ -1,0 +1,244 @@
+// Core of madtpu_replay (raw-raft differential replay), shared by the CLI
+// binary (replay_main.cpp) and the in-process C API (capi.cpp /
+// libmadtpu.so -> madraft_tpu/simcore.py). See replay_main.cpp for the
+// schedule format and the bridge contract.
+#pragma once
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "../raftcore/raft.h"
+#include "env_guard.h"
+
+namespace madtpu_replay {
+
+using namespace raftcore;
+using simcore::Addr;
+using simcore::make_addr;
+using simcore::MSEC;
+using simcore::Sim;
+
+struct Event {
+  uint64_t tick;
+  bool is_alive;                  // else adj
+  uint64_t alive_mask;
+  std::vector<uint64_t> adj_rows;
+};
+
+struct Schedule {
+  int nodes = 0;
+  uint64_t ms_per_tick = 10;
+  uint64_t ticks = 0;
+  int majority_override = 0;
+  uint64_t seed = 0;
+  std::vector<Event> events;      // sorted by tick
+};
+
+inline bool parse_schedule(FILE* f, Schedule* out) {
+  char line[4096];
+  while (std::fgets(line, sizeof line, f)) {
+    if (line[0] == '#' || line[0] == '\n') continue;
+    char kw[64];
+    if (std::sscanf(line, "%63s", kw) != 1) continue;
+    if (!std::strcmp(kw, "nodes")) {
+      std::sscanf(line, "%*s %d", &out->nodes);
+    } else if (!std::strcmp(kw, "ms_per_tick")) {
+      std::sscanf(line, "%*s %" SCNu64, &out->ms_per_tick);
+    } else if (!std::strcmp(kw, "ticks")) {
+      std::sscanf(line, "%*s %" SCNu64, &out->ticks);
+    } else if (!std::strcmp(kw, "majority_override")) {
+      std::sscanf(line, "%*s %d", &out->majority_override);
+    } else if (!std::strcmp(kw, "seed")) {
+      std::sscanf(line, "%*s %" SCNu64, &out->seed);
+    } else if (!std::strcmp(kw, "ev")) {
+      Event ev{};
+      char kind[32];
+      int consumed = 0;
+      if (std::sscanf(line, "%*s %" SCNu64 " %31s %n", &ev.tick, kind,
+                      &consumed) < 2)
+        continue;
+      const char* rest = line + consumed;
+      if (!std::strcmp(kind, "alive")) {
+        ev.is_alive = true;
+        ev.alive_mask = std::strtoull(rest, nullptr, 16);
+      } else {
+        ev.is_alive = false;
+        char* end = nullptr;
+        const char* p = rest;
+        for (int i = 0; i < out->nodes; i++) {
+          ev.adj_rows.push_back(std::strtoull(p, &end, 16));
+          p = end;
+        }
+      }
+      out->events.push_back(std::move(ev));
+    }
+  }
+  if (out->nodes <= 0 || out->ticks == 0) return false;
+  // an adj event parsed before the `nodes` line has too few rows; reject
+  // rather than index out of bounds at replay time
+  for (const auto& ev : out->events)
+    if (!ev.is_alive && ev.adj_rows.size() != (size_t)out->nodes) return false;
+  return true;
+}
+
+// Replay harness: like RaftTester but violations are REPORTED, not aborted —
+// the bridge's whole point is to observe them.
+struct Replay {
+  Sim* sim;
+  int n;
+  std::vector<Addr> addrs;
+  std::vector<std::shared_ptr<Raft>> rafts;
+  std::vector<std::vector<uint64_t>> storage;  // applied values, 1-based
+  bool dual_leader = false;
+  bool commit_mismatch = false;
+  bool apply_disorder = false;
+  uint64_t first_violation_ms = 0;
+  uint64_t max_applied = 0;
+
+  Replay(Sim* s, int n_) : sim(s), n(n_) {
+    for (int i = 0; i < n; i++) addrs.push_back(make_addr(0, 0, 1, i + 1));
+    rafts.resize(n);
+    storage.resize(n);
+  }
+
+  void flag(bool* which) {
+    if (!dual_leader && !commit_mismatch && !apply_disorder)
+      first_violation_ms = sim->now() / MSEC;
+    *which = true;
+  }
+
+  void push_and_check(int i, uint64_t index, uint64_t v) {
+    for (int j = 0; j < n; j++)
+      if (j != i && storage[j].size() >= index && storage[j][index - 1] != v)
+        flag(&commit_mismatch);
+    if (index == storage[i].size() + 1) {
+      storage[i].push_back(v);
+    } else if (index <= storage[i].size()) {
+      if (storage[i][index - 1] != v) flag(&commit_mismatch);
+    } else {
+      flag(&apply_disorder);
+    }
+    max_applied = std::max<uint64_t>(max_applied, storage[i].size());
+  }
+
+  static simcore::Task<void> applier(Replay* r, int i,
+                                     simcore::Channel<ApplyMsg> ch) {
+    for (;;) {
+      auto m = co_await ch.recv();
+      if (!m) break;
+      if (m->is_snapshot) {
+        if (r->rafts[i] &&
+            r->rafts[i]->cond_install_snapshot(m->term, m->index, m->data)) {
+          Dec d(m->data);
+          uint64_t len = d.u64();
+          r->storage[i].clear();
+          for (uint64_t k = 0; k < len; k++) r->storage[i].push_back(d.u64());
+        }
+      } else {
+        r->push_and_check(i, m->index, dec_u64(m->data));
+      }
+    }
+  }
+
+  simcore::Task<void> start1(int i) {
+    sim->kill(addrs[i]);
+    rafts[i] = nullptr;
+    simcore::Channel<ApplyMsg> ch;
+    rafts[i] = co_await sim->spawn(addrs[i], Raft::boot(sim, addrs, i, ch));
+    sim->spawn(addrs[i], applier(this, i, ch));
+  }
+
+  void crash1(int i) {
+    sim->kill(addrs[i]);
+    rafts[i] = nullptr;
+  }
+};
+
+inline simcore::Task<void> client_task(Replay* r, uint64_t end_ns) {
+  uint64_t cmd = 1;
+  while (r->sim->now() < end_ns) {
+    for (int i = 0; i < r->n; i++)
+      if (r->rafts[i] && r->rafts[i]->is_leader())
+        r->rafts[i]->start(enc_u64(cmd++));
+    co_await r->sim->sleep(20 * MSEC);
+  }
+}
+
+inline simcore::Task<void> leader_poll_task(Replay* r, uint64_t end_ns) {
+  while (r->sim->now() < end_ns) {
+    std::map<uint64_t, int> leaders;
+    for (int i = 0; i < r->n; i++)
+      if (r->rafts[i] && r->rafts[i]->is_leader())
+        if (++leaders[r->rafts[i]->term()] > 1) r->flag(&r->dual_leader);
+    co_await r->sim->sleep(5 * MSEC);
+  }
+}
+
+inline simcore::Task<void> replay_driver(Sim* sim, Replay* r,
+                                         const Schedule* sch) {
+  for (int i = 0; i < r->n; i++) {
+    co_await sim->spawn(r->start1(i));
+    sim->connect(r->addrs[i]);
+  }
+  uint64_t end_ns = sch->ticks * sch->ms_per_tick * MSEC;
+  sim->spawn(Addr(0), client_task(r, end_ns));       // TaskRef is non-owning
+  sim->spawn(Addr(0), leader_poll_task(r, end_ns));  // (drop = detach)
+
+  uint64_t alive = ~0ull;
+  for (const auto& ev : sch->events) {
+    uint64_t at = ev.tick * sch->ms_per_tick * MSEC;
+    if (at > sim->now()) co_await sim->sleep(at - sim->now());
+    if (ev.is_alive) {
+      for (int i = 0; i < r->n; i++) {
+        bool was = (alive >> i) & 1, now = (ev.alive_mask >> i) & 1;
+        if (was && !now) r->crash1(i);
+        if (!was && now) co_await sim->spawn(r->start1(i));
+      }
+      alive = ev.alive_mask;
+    } else {
+      for (int i = 0; i < r->n; i++)
+        for (int j = i + 1; j < r->n; j++) {
+          bool up = (ev.adj_rows[i] >> j) & 1;
+          if (up)
+            sim->connect2(r->addrs[i], r->addrs[j]);
+          else
+            sim->disconnect2(r->addrs[i], r->addrs[j]);
+        }
+    }
+  }
+  if (end_ns > sim->now()) co_await sim->sleep(end_ns - sim->now());
+}
+
+// Run a schedule; returns the one-line JSON report ("" = sim deadlock).
+// The majority override is applied via env so raftcore's quorum() (which
+// reads it per call, uncached) sees it — and restored afterwards so
+// in-process callers can interleave overridden and clean replays. Callers
+// serialize (capi.cpp holds a mutex); env mutation is not thread-safe.
+inline std::string run_schedule(const Schedule& sch) {
+  char buf[16] = {0};
+  if (sch.majority_override > 0)
+    std::snprintf(buf, sizeof buf, "%d", sch.majority_override);
+  madtpu_tools::EnvGuard guard(
+      "MADTPU_MAJORITY_OVERRIDE",
+      sch.majority_override > 0 ? buf : nullptr);
+  Sim sim(sch.seed);
+  Replay r(&sim, sch.nodes);
+  if (!sim.run(replay_driver(&sim, &r, &sch))) return "";
+  char out[512];
+  std::snprintf(
+      out, sizeof out,
+      "{\"dual_leader\": %d, \"commit_mismatch\": %d, \"apply_disorder\": %d, "
+      "\"first_violation_ms\": %" PRIu64 ", \"max_applied\": %" PRIu64
+      ", \"rpcs\": %" PRIu64 "}",
+      (int)r.dual_leader, (int)r.commit_mismatch, (int)r.apply_disorder,
+      r.first_violation_ms, r.max_applied, sim.msg_count() / 2);
+  return out;
+}
+
+}  // namespace madtpu_replay

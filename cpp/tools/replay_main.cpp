@@ -21,245 +21,28 @@
 //
 // Output: one JSON line {"dual_leader":0|1,"commit_mismatch":0|1,...};
 // exit 0 if the replay ran (violations are data, not errors).
-#include <cinttypes>
-#include <cstdio>
-#include <cstdlib>
-#include <cstring>
-#include <map>
-#include <memory>
-#include <string>
-#include <vector>
-
-#include "../raftcore/raft.h"
-
-using namespace raftcore;
-using simcore::Addr;
-using simcore::make_addr;
-using simcore::MSEC;
-using simcore::Sim;
-
-namespace {
-
-struct Event {
-  uint64_t tick;
-  bool is_alive;                  // else adj
-  uint64_t alive_mask;
-  std::vector<uint64_t> adj_rows;
-};
-
-struct Schedule {
-  int nodes = 0;
-  uint64_t ms_per_tick = 10;
-  uint64_t ticks = 0;
-  int majority_override = 0;
-  uint64_t seed = 0;
-  std::vector<Event> events;      // sorted by tick
-};
-
-bool parse_schedule(const char* path, Schedule* out) {
-  FILE* f = std::fopen(path, "r");
-  if (!f) return false;
-  char line[4096];
-  while (std::fgets(line, sizeof line, f)) {
-    if (line[0] == '#' || line[0] == '\n') continue;
-    char kw[64];
-    if (std::sscanf(line, "%63s", kw) != 1) continue;
-    if (!std::strcmp(kw, "nodes")) {
-      std::sscanf(line, "%*s %d", &out->nodes);
-    } else if (!std::strcmp(kw, "ms_per_tick")) {
-      std::sscanf(line, "%*s %" SCNu64, &out->ms_per_tick);
-    } else if (!std::strcmp(kw, "ticks")) {
-      std::sscanf(line, "%*s %" SCNu64, &out->ticks);
-    } else if (!std::strcmp(kw, "majority_override")) {
-      std::sscanf(line, "%*s %d", &out->majority_override);
-    } else if (!std::strcmp(kw, "seed")) {
-      std::sscanf(line, "%*s %" SCNu64, &out->seed);
-    } else if (!std::strcmp(kw, "ev")) {
-      Event ev{};
-      char kind[32];
-      int consumed = 0;
-      if (std::sscanf(line, "%*s %" SCNu64 " %31s %n", &ev.tick, kind,
-                      &consumed) < 2)
-        continue;
-      const char* rest = line + consumed;
-      if (!std::strcmp(kind, "alive")) {
-        ev.is_alive = true;
-        ev.alive_mask = std::strtoull(rest, nullptr, 16);
-      } else {
-        ev.is_alive = false;
-        char* end = nullptr;
-        const char* p = rest;
-        for (int i = 0; i < out->nodes; i++) {
-          ev.adj_rows.push_back(std::strtoull(p, &end, 16));
-          p = end;
-        }
-      }
-      out->events.push_back(std::move(ev));
-    }
-  }
-  std::fclose(f);
-  if (out->nodes <= 0 || out->ticks == 0) return false;
-  // an adj event parsed before the `nodes` line has too few rows; reject
-  // rather than index out of bounds at replay time
-  for (const auto& ev : out->events)
-    if (!ev.is_alive && ev.adj_rows.size() != (size_t)out->nodes) return false;
-  return true;
-}
-
-// Replay harness: like RaftTester but violations are REPORTED, not aborted —
-// the bridge's whole point is to observe them.
-struct Replay {
-  Sim* sim;
-  int n;
-  std::vector<Addr> addrs;
-  std::vector<std::shared_ptr<Raft>> rafts;
-  std::vector<std::vector<uint64_t>> storage;  // applied values, 1-based
-  bool dual_leader = false;
-  bool commit_mismatch = false;
-  bool apply_disorder = false;
-  uint64_t first_violation_ms = 0;
-  uint64_t max_applied = 0;
-
-  Replay(Sim* s, int n_) : sim(s), n(n_) {
-    for (int i = 0; i < n; i++) addrs.push_back(make_addr(0, 0, 1, i + 1));
-    rafts.resize(n);
-    storage.resize(n);
-  }
-
-  void flag(bool* which) {
-    if (!dual_leader && !commit_mismatch && !apply_disorder)
-      first_violation_ms = sim->now() / MSEC;
-    *which = true;
-  }
-
-  void push_and_check(int i, uint64_t index, uint64_t v) {
-    for (int j = 0; j < n; j++)
-      if (j != i && storage[j].size() >= index && storage[j][index - 1] != v)
-        flag(&commit_mismatch);
-    if (index == storage[i].size() + 1) {
-      storage[i].push_back(v);
-    } else if (index <= storage[i].size()) {
-      if (storage[i][index - 1] != v) flag(&commit_mismatch);
-    } else {
-      flag(&apply_disorder);
-    }
-    max_applied = std::max<uint64_t>(max_applied, storage[i].size());
-  }
-
-  static Task<void> applier(Replay* r, int i, Channel<ApplyMsg> ch) {
-    for (;;) {
-      auto m = co_await ch.recv();
-      if (!m) break;
-      if (m->is_snapshot) {
-        if (r->rafts[i] &&
-            r->rafts[i]->cond_install_snapshot(m->term, m->index, m->data)) {
-          Dec d(m->data);
-          uint64_t len = d.u64();
-          r->storage[i].clear();
-          for (uint64_t k = 0; k < len; k++) r->storage[i].push_back(d.u64());
-        }
-      } else {
-        r->push_and_check(i, m->index, dec_u64(m->data));
-      }
-    }
-  }
-
-  Task<void> start1(int i) {
-    sim->kill(addrs[i]);
-    rafts[i] = nullptr;
-    Channel<ApplyMsg> ch;
-    rafts[i] = co_await sim->spawn(addrs[i], Raft::boot(sim, addrs, i, ch));
-    sim->spawn(addrs[i], applier(this, i, ch));
-  }
-
-  void crash1(int i) {
-    sim->kill(addrs[i]);
-    rafts[i] = nullptr;
-  }
-};
-
-Task<void> client_task(Replay* r, uint64_t end_ns) {
-  uint64_t cmd = 1;
-  while (r->sim->now() < end_ns) {
-    for (int i = 0; i < r->n; i++)
-      if (r->rafts[i] && r->rafts[i]->is_leader())
-        r->rafts[i]->start(enc_u64(cmd++));
-    co_await r->sim->sleep(20 * MSEC);
-  }
-}
-
-Task<void> leader_poll_task(Replay* r, uint64_t end_ns) {
-  while (r->sim->now() < end_ns) {
-    std::map<uint64_t, int> leaders;
-    for (int i = 0; i < r->n; i++)
-      if (r->rafts[i] && r->rafts[i]->is_leader())
-        if (++leaders[r->rafts[i]->term()] > 1) r->flag(&r->dual_leader);
-    co_await r->sim->sleep(5 * MSEC);
-  }
-}
-
-Task<void> replay_main(Sim* sim, Replay* r, const Schedule* sch) {
-  for (int i = 0; i < r->n; i++) {
-    co_await sim->spawn(r->start1(i));
-    sim->connect(r->addrs[i]);
-  }
-  uint64_t end_ns = sch->ticks * sch->ms_per_tick * MSEC;
-  sim->spawn(Addr(0), client_task(r, end_ns));       // TaskRef is non-owning
-  sim->spawn(Addr(0), leader_poll_task(r, end_ns));  // (drop = detach)
-
-  uint64_t alive = ~0ull;
-  for (const auto& ev : sch->events) {
-    uint64_t at = ev.tick * sch->ms_per_tick * MSEC;
-    if (at > sim->now()) co_await sim->sleep(at - sim->now());
-    if (ev.is_alive) {
-      for (int i = 0; i < r->n; i++) {
-        bool was = (alive >> i) & 1, now = (ev.alive_mask >> i) & 1;
-        if (was && !now) r->crash1(i);
-        if (!was && now) co_await sim->spawn(r->start1(i));
-      }
-      alive = ev.alive_mask;
-    } else {
-      for (int i = 0; i < r->n; i++)
-        for (int j = i + 1; j < r->n; j++) {
-          bool up = (ev.adj_rows[i] >> j) & 1;
-          if (up)
-            sim->connect2(r->addrs[i], r->addrs[j]);
-          else
-            sim->disconnect2(r->addrs[i], r->addrs[j]);
-        }
-    }
-  }
-  if (end_ns > sim->now()) co_await sim->sleep(end_ns - sim->now());
-}
-
-}  // namespace
+// Core logic lives in replay_core.h, shared with the in-process C API
+// (capi.cpp -> libmadtpu.so -> madraft_tpu/simcore.py).
+#include "replay_core.h"
 
 int main(int argc, char** argv) {
   if (argc < 2) {
     std::fprintf(stderr, "usage: %s <schedule-file>\n", argv[0]);
     return 2;
   }
-  Schedule sch;
-  if (!parse_schedule(argv[1], &sch)) {
+  FILE* f = std::fopen(argv[1], "r");
+  madtpu_replay::Schedule sch;
+  bool ok = f && madtpu_replay::parse_schedule(f, &sch);
+  if (f) std::fclose(f);
+  if (!ok) {
     std::fprintf(stderr, "bad schedule file: %s\n", argv[1]);
     return 2;
   }
-  if (sch.majority_override > 0) {
-    char buf[16];
-    std::snprintf(buf, sizeof buf, "%d", sch.majority_override);
-    setenv("MADTPU_MAJORITY_OVERRIDE", buf, 1);
-  }
-  Sim sim(sch.seed);
-  Replay r(&sim, sch.nodes);
-  if (!sim.run(replay_main(&sim, &r, &sch))) {
+  std::string report = madtpu_replay::run_schedule(sch);
+  if (report.empty()) {
     std::fprintf(stderr, "sim deadlocked\n");
     return 2;
   }
-  std::printf(
-      "{\"dual_leader\": %d, \"commit_mismatch\": %d, \"apply_disorder\": %d, "
-      "\"first_violation_ms\": %" PRIu64 ", \"max_applied\": %" PRIu64
-      ", \"rpcs\": %" PRIu64 "}\n",
-      (int)r.dual_leader, (int)r.commit_mismatch, (int)r.apply_disorder,
-      r.first_violation_ms, r.max_applied, sim.msg_count() / 2);
+  std::printf("%s\n", report.c_str());
   return 0;
 }

@@ -32,254 +32,33 @@
 //   stale_read — a Get is missing an append the same clerk had already
 //                completed before the Get was invoked
 // Output: one JSON line; exit 0 if the replay ran.
-#include <cinttypes>
-#include <cstdio>
-#include <cstdlib>
-#include <cstring>
-#include <memory>
-#include <string>
-#include <vector>
-
-#include "../shardkv/shardkv_tester.h"
-
-using namespace shardkv;
-using simcore::Addr;
-using simcore::make_addr;
-using simcore::MSEC;
-using simcore::Sim;
-using simcore::Task;
-
-namespace {
-
-struct CfgEvent {
-  uint64_t tick = 0;
-  std::array<int, shard_ctrler::N_SHARDS> owner{};  // 0..G-1
-};
-
-struct AliveEvent {
-  uint64_t tick = 0;
-  int group = 0;
-  uint64_t mask = 0;
-};
-
-struct Schedule {
-  int groups = 0;
-  int nodes = 0;
-  uint64_t ticks = 0;
-  uint64_t ms_per_tick = 10;
-  uint64_t seed = 0;
-  std::string bug = "none";
-  std::vector<CfgEvent> cfgs;       // sorted by tick
-  std::vector<AliveEvent> alives;   // sorted by tick
-};
-
-bool parse_schedule(const char* path, Schedule* out) {
-  FILE* f = std::fopen(path, "r");
-  if (!f) return false;
-  char line[4096];
-  while (std::fgets(line, sizeof line, f)) {
-    if (line[0] == '#' || line[0] == '\n') continue;
-    char kw[64];
-    if (std::sscanf(line, "%63s", kw) != 1) continue;
-    if (!std::strcmp(kw, "groups")) {
-      std::sscanf(line, "%*s %d", &out->groups);
-    } else if (!std::strcmp(kw, "nodes")) {
-      std::sscanf(line, "%*s %d", &out->nodes);
-    } else if (!std::strcmp(kw, "ticks")) {
-      std::sscanf(line, "%*s %" SCNu64, &out->ticks);
-    } else if (!std::strcmp(kw, "ms_per_tick")) {
-      std::sscanf(line, "%*s %" SCNu64, &out->ms_per_tick);
-    } else if (!std::strcmp(kw, "seed")) {
-      std::sscanf(line, "%*s %" SCNu64, &out->seed);
-    } else if (!std::strcmp(kw, "bug")) {
-      char b[64];
-      if (std::sscanf(line, "%*s %63s", b) == 1) out->bug = b;
-    } else if (!std::strcmp(kw, "cfg")) {
-      CfgEvent ev;
-      int consumed = 0;
-      if (std::sscanf(line, "%*s %" SCNu64 " %n", &ev.tick, &consumed) < 1)
-        continue;
-      const char* p = line + consumed;
-      char* end = nullptr;
-      for (auto& o : ev.owner) {
-        o = int(std::strtol(p, &end, 10));
-        p = end;
-      }
-      out->cfgs.push_back(ev);
-    } else if (!std::strcmp(kw, "ev")) {
-      AliveEvent ev;
-      char kind[32];
-      int consumed = 0;
-      if (std::sscanf(line, "%*s %" SCNu64 " %31s %d %n", &ev.tick, kind,
-                      &ev.group, &consumed) < 3 ||
-          std::strcmp(kind, "alive"))
-        continue;
-      ev.mask = std::strtoull(line + consumed, nullptr, 16);
-      out->alives.push_back(ev);
-    }
-  }
-  std::fclose(f);
-  if (out->groups <= 0 || out->nodes <= 0 || out->ticks == 0) return false;
-  for (const auto& ev : out->cfgs)
-    for (int o : ev.owner)
-      if (o < 0 || o >= out->groups) return false;
-  for (const auto& ev : out->alives)
-    if (ev.group < 0 || ev.group >= out->groups) return false;
-  return true;
-}
-
-struct Flags {
-  bool dup_apply = false;
-  bool stale_read = false;
-  uint64_t ops = 0;
-  uint64_t gets = 0;
-  uint64_t first_violation_ms = 0;
-  // Global acked-write oracle, shared by every clerk in the process: an
-  // append that completed (by ANY client) before a Get was invoked must
-  // appear in that Get's output — the client-side form of the TPU fuzzer's
-  // global interval oracle. Per-clerk-only checks cannot catch serve-frozen
-  // staleness: a clerk's cached config only moves forward, so its OWN
-  // tokens are always present in the frozen copy it might be served.
-  std::map<std::string, std::vector<std::pair<uint64_t, std::string>>>
-      acked;  // key -> (ack virtual time, token)
-};
-
-// One clerk's workload: append own tokens to shared per-shard keys, read
-// back. Checks: every (anyone's) token acked before the Get's invoke is
-// present (stale_read), and own tokens appear exactly once, in order
-// (dup_apply — exactly-once across migration, check_clnt_appends style).
-Task<void> clerk_task(Sim* sim, ShardKvTester* t, Flags* fl, int id,
-                      uint64_t end_ns) {
-  auto ck = t->make_client();
-  uint64_t seq = 0;
-  auto flag = [&](bool* which) {
-    if (!fl->dup_apply && !fl->stale_read)
-      fl->first_violation_ms = sim->now() / MSEC;
-    *which = true;
-  };
-  char own_prefix[16];
-  std::snprintf(own_prefix, sizeof own_prefix, "c%d.", id);
-  while (sim->now() < end_ns) {
-    std::string key(1, char('0' + int((seq + id) % shard_ctrler::N_SHARDS)));
-    char token[48];
-    std::snprintf(token, sizeof token, "c%d.%" PRIu64 ";", id, seq);
-    seq++;
-    co_await ck.append(key, token);
-    fl->acked[key].emplace_back(sim->now(), token);
-    fl->ops++;
-    if (sim->now() >= end_ns) break;
-    uint64_t invoke = sim->now();
-    std::string v = co_await ck.get(key);
-    fl->gets++;
-    size_t own_pos = 0;
-    for (const auto& [ack_t, tok] : fl->acked[key]) {
-      size_t first = v.find(tok);
-      if (first == std::string::npos) {
-        // only writes acked before OUR invoke are guaranteed visible
-        if (ack_t < invoke) flag(&fl->stale_read);
-        continue;
-      }
-      if (tok.compare(0, std::strlen(own_prefix), own_prefix) == 0) {
-        if (v.find(tok, first + tok.size()) != std::string::npos ||
-            first < own_pos)
-          flag(&fl->dup_apply);  // applied twice, or out of client order
-        own_pos = first + tok.size();
-      }
-    }
-  }
-}
-
-// Drive the real ctrler through the TPU's pre-drawn owner maps: initial
-// Join of every group, then per config event one Move per changed shard
-// (each Move is one ctrler config; groups chain through all of them with
-// the full migration protocol — same reconfiguration pressure, class-level
-// equivalence).
-Task<void> config_driver(Sim* sim, ShardKvTester* t,
-                         std::shared_ptr<CtrlerClerk> ck,
-                         const Schedule* sch, uint64_t end_ns) {
-  std::vector<int> all;
-  for (int g = 0; g < sch->groups; g++) all.push_back(g);
-  co_await t->joins(all);
-  std::array<int, shard_ctrler::N_SHARDS> cur{};
-  cur.fill(-1);
-  for (const auto& ev : sch->cfgs) {
-    uint64_t at = ev.tick * sch->ms_per_tick * MSEC;
-    if (at >= end_ns) break;
-    if (at > sim->now()) co_await sim->sleep(at - sim->now());
-    for (size_t s = 0; s < shard_ctrler::N_SHARDS; s++) {
-      if (cur[s] == ev.owner[s]) continue;
-      co_await ck->move_(s, t->gid_of(ev.owner[s]));
-      cur[s] = ev.owner[s];
-    }
-  }
-}
-
-Task<void> fault_driver(Sim* sim, ShardKvTester* t, const Schedule* sch,
-                        uint64_t end_ns) {
-  std::vector<uint64_t> alive(sch->groups, ~0ull);
-  for (const auto& ev : sch->alives) {
-    uint64_t at = ev.tick * sch->ms_per_tick * MSEC;
-    if (at >= end_ns) break;
-    if (at > sim->now()) co_await sim->sleep(at - sim->now());
-    for (int i = 0; i < sch->nodes; i++) {
-      bool was = (alive[ev.group] >> i) & 1, now = (ev.mask >> i) & 1;
-      if (was && !now) t->shutdown_server(ev.group, i);
-      if (!was && now) co_await sim->spawn(t->start_server(ev.group, i));
-    }
-    alive[ev.group] = ev.mask;
-  }
-}
-
-Task<void> replay_main(Sim* sim, ShardKvTester* t, Flags* fl,
-                       const Schedule* sch) {
-  co_await t->init();
-  uint64_t end_ns = sch->ticks * sch->ms_per_tick * MSEC;
-  auto ctrl_ck = std::make_shared<CtrlerClerk>(
-      sim, std::vector<Addr>{make_addr(0, 0, 1, 0), make_addr(0, 0, 1, 1),
-                             make_addr(0, 0, 1, 2)},
-      9000);
-  std::vector<simcore::TaskRef<void>> tasks;
-  tasks.push_back(sim->spawn(
-      Addr(make_addr(0, 0, 3, 90)), config_driver(sim, t, ctrl_ck, sch, end_ns)));
-  tasks.push_back(
-      sim->spawn(Addr(make_addr(0, 0, 3, 91)), fault_driver(sim, t, sch, end_ns)));
-  for (int c = 0; c < 8; c++)
-    tasks.push_back(sim->spawn(Addr(make_addr(0, 0, 3, 92 + c)),
-                               clerk_task(sim, t, fl, c, end_ns)));
-  if (end_ns > sim->now()) co_await sim->sleep(end_ns - sim->now());
-}
-
-}  // namespace
+// Core logic lives in shardkv_replay_core.h, shared with the in-process
+// C API (capi.cpp -> libmadtpu.so -> madraft_tpu/simcore.py).
+#include "shardkv_replay_core.h"
 
 int main(int argc, char** argv) {
   if (argc < 2) {
     std::fprintf(stderr, "usage: %s <schedule-file>\n", argv[0]);
     return 2;
   }
-  Schedule sch;
-  if (!parse_schedule(argv[1], &sch)) {
+  FILE* f = std::fopen(argv[1], "r");
+  madtpu_shardkv_replay::Schedule sch;
+  bool ok = f && madtpu_shardkv_replay::parse_schedule(f, &sch);
+  if (f) std::fclose(f);
+  if (!ok) {
     std::fprintf(stderr, "bad schedule file: %s\n", argv[1]);
     return 2;
   }
-  if (sch.bug != "none") setenv("MADTPU_SHARDKV_BUG", sch.bug.c_str(), 1);
-  if (sch.groups > ShardKvTester::N_GROUPS) {
+  if (sch.groups > madtpu_shardkv_replay::ShardKvTester::N_GROUPS) {
     std::fprintf(stderr, "at most %d groups supported\n",
-                 ShardKvTester::N_GROUPS);
+                 madtpu_shardkv_replay::ShardKvTester::N_GROUPS);
     return 2;
   }
-  Sim sim(sch.seed);
-  ShardKvTester t(&sim, sch.nodes, /*unreliable=*/true,
-                  /*max_raft_state=*/1000);
-  Flags fl;
-  if (!sim.run(replay_main(&sim, &t, &fl, &sch))) {
+  std::string report = madtpu_shardkv_replay::run_schedule(sch);
+  if (report.empty()) {
     std::fprintf(stderr, "sim deadlocked\n");
     return 2;
   }
-  std::printf(
-      "{\"dup_apply\": %d, \"stale_read\": %d, \"ops\": %" PRIu64
-      ", \"gets\": %" PRIu64 ", \"first_violation_ms\": %" PRIu64
-      ", \"rpcs\": %" PRIu64 "}\n",
-      (int)fl.dup_apply, (int)fl.stale_read, fl.ops, fl.gets,
-      fl.first_violation_ms, sim.msg_count() / 2);
+  std::printf("%s\n", report.c_str());
   return 0;
 }

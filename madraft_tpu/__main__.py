@@ -1,0 +1,184 @@
+"""madtpu CLI — the front door over the batched fuzzers and the bridge
+(SURVEY.md §7 architecture item 4's "CLI" deliverable).
+
+    python -m madraft_tpu fuzz        --clusters 4096 --ticks 1024 [--storm]
+    python -m madraft_tpu kv-fuzz     --clusters 512  --ticks 512
+    python -m madraft_tpu shardkv-fuzz --clusters 64  --ticks 640
+    python -m madraft_tpu replay      --seed S --cluster C --ticks T [--storm]
+    python -m madraft_tpu bridge      --seed S --cluster C --ticks T [--storm]
+
+Every command prints one JSON line (machine-readable; violations are data).
+A violating cluster reported by `fuzz` is reproduced exactly by `replay`
+with the same (seed, cluster) — the MADSIM_TEST_SEED replay contract — and
+`bridge` closes the loop by re-running its fault schedule on the C++
+runtime via the in-process bindings (madraft_tpu.simcore).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _storm(cfg):
+    return cfg.replace(
+        p_client_cmd=0.2, loss_prob=0.1, p_crash=0.01, p_restart=0.2,
+        max_dead=2, p_repartition=0.02, p_heal=0.05,
+    )
+
+
+def _sim_config(args):
+    from madraft_tpu.tpusim import SimConfig
+
+    cfg = SimConfig(n_nodes=args.nodes)
+    if args.storm:
+        cfg = _storm(cfg)
+    if args.majority_override:
+        cfg = cfg.replace(majority_override=args.majority_override)
+    return cfg
+
+
+def _report_json(rep, extra=None):
+    out = {
+        "violating": int(rep.n_violating),
+        "violating_clusters": [int(c) for c in rep.violating_clusters()[:16]],
+    }
+    for f in rep._fields:
+        v = getattr(rep, f)
+        if hasattr(v, "mean"):
+            out[f"{f}_mean"] = round(float(v.mean()), 2)
+    if extra:
+        out.update(extra)
+    print(json.dumps(out))
+
+
+def cmd_fuzz(args):
+    from madraft_tpu.tpusim.engine import fuzz
+
+    rep = fuzz(_sim_config(args), seed=args.seed, n_clusters=args.clusters,
+               n_ticks=args.ticks)
+    _report_json(rep, {"seed": args.seed})
+    return 1 if rep.n_violating else 0
+
+
+def cmd_kv_fuzz(args):
+    from madraft_tpu.tpusim.kv import KvConfig, kv_fuzz
+
+    cfg = _sim_config(args).replace(
+        p_client_cmd=0.0, compact_at_commit=False, compact_every=16
+    )
+    rep = kv_fuzz(cfg, KvConfig(p_get=args.p_get), seed=args.seed,
+                  n_clusters=args.clusters, n_ticks=args.ticks)
+    _report_json(rep, {"seed": args.seed})
+    return 1 if rep.n_violating else 0
+
+
+def cmd_shardkv_fuzz(args):
+    from madraft_tpu.tpusim import SimConfig
+    from madraft_tpu.tpusim.shardkv import ShardKvConfig, shardkv_fuzz
+
+    cfg = SimConfig(
+        n_nodes=args.nodes, p_client_cmd=0.0, compact_at_commit=False,
+        log_cap=64, compact_every=16,
+        loss_prob=0.1 if args.storm else 0.05,
+        p_crash=0.01 if args.storm else 0.0,
+        p_restart=0.2, max_dead=1 if args.storm else 0,
+    )
+    rep = shardkv_fuzz(cfg, ShardKvConfig(p_get=args.p_get), seed=args.seed,
+                       n_clusters=args.clusters, n_ticks=args.ticks)
+    _report_json(rep, {"seed": args.seed})
+    return 1 if rep.n_violating else 0
+
+
+def cmd_replay(args):
+    import numpy as np
+
+    from madraft_tpu.tpusim.engine import replay_cluster
+
+    st = replay_cluster(_sim_config(args), args.seed, args.cluster, args.ticks)
+    print(json.dumps({
+        "seed": args.seed,
+        "cluster": args.cluster,
+        "violations": int(st.violations),
+        "first_violation_tick": int(st.first_violation_tick),
+        "committed": int(st.shadow_len),
+        "terms": np.asarray(st.term).tolist(),
+    }))
+    return 1 if int(st.violations) else 0
+
+
+def cmd_bridge(args):
+    from madraft_tpu import bridge
+
+    sched = bridge.extract_schedule(_sim_config(args), seed=args.seed,
+                                    cluster_id=args.cluster, n_ticks=args.ticks)
+    cpp = bridge.replay_on_simcore(sched)
+    match = bridge.classes_match(sched.violations, cpp)
+    print(json.dumps({
+        "tpu_violations": sched.violations,
+        "cpp_report": cpp,
+        "classes_match": match,
+    }))
+    # failure = a TPU-found violation the C++ replay could NOT reproduce
+    return 1 if (sched.violations and not match) else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m madraft_tpu",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp, clusters):
+        sp.add_argument("--platform", default=None,
+                        help="force a JAX backend (e.g. cpu) — by default "
+                             "the attached accelerator is used")
+        sp.add_argument("--seed", type=int, default=12345)
+        sp.add_argument("--nodes", type=int, default=5)
+        sp.add_argument("--clusters", type=int, default=clusters)
+        sp.add_argument("--ticks", type=int, default=512)
+        sp.add_argument("--storm", action="store_true",
+                        help="full fault storm (loss+crash+partitions)")
+        sp.add_argument("--majority-override", type=int, default=0,
+                        help="deliberately broken quorum (oracle demo)")
+
+    sp = sub.add_parser("fuzz", help="raw-raft batched fuzz")
+    common(sp, 4096)
+    sp.set_defaults(fn=cmd_fuzz)
+
+    sp = sub.add_parser("kv-fuzz", help="KV service fuzz (Lab 3)")
+    common(sp, 512)
+    sp.add_argument("--p-get", type=float, default=0.3)
+    sp.set_defaults(fn=cmd_kv_fuzz)
+
+    sp = sub.add_parser("shardkv-fuzz", help="multi-group sharded KV (Lab 4B)")
+    common(sp, 64)
+    sp.add_argument("--p-get", type=float, default=0.3)
+    sp.set_defaults(fn=cmd_shardkv_fuzz)
+
+    sp = sub.add_parser("replay", help="re-run ONE cluster exactly")
+    common(sp, 1)
+    sp.add_argument("--cluster", type=int, required=True)
+    sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser(
+        "bridge", help="export a cluster's fault schedule and replay on C++"
+    )
+    common(sp, 1)
+    sp.add_argument("--cluster", type=int, required=True)
+    sp.set_defaults(fn=cmd_bridge)
+
+    args = p.parse_args(argv)
+    # must run before any backend init; also honored via MADTPU_PLATFORM
+    import os
+
+    plat = args.platform or os.environ.get("MADTPU_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

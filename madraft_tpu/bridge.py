@@ -152,7 +152,15 @@ def replay_on_simcore(
     binary: Optional[pathlib.Path] = None,
     workdir: Optional[pathlib.Path] = None,
 ) -> dict:
-    """Run the C++ replayer on a schedule; returns its JSON report."""
+    """Run the C++ replayer on a schedule; returns its JSON report.
+
+    In-process by default (madraft_tpu.simcore ctypes bindings — no
+    fork/exec per replay); pass ``binary`` to force the CLI subprocess."""
+    if binary is None:
+        from madraft_tpu import simcore
+
+        if simcore.available():
+            return simcore.replay_schedule(schedule.dumps())
     binary = pathlib.Path(binary or DEFAULT_BINARY)
     # unique file per replay: concurrent replays must not clobber each other
     with tempfile.NamedTemporaryFile(
@@ -277,7 +285,13 @@ def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
 def check_history_on_simcore(
     lines: list[str], binary: Optional[pathlib.Path] = None
 ) -> bool:
-    """Run the C++ Wing-Gong checker on an exported history; True = linearizable."""
+    """Run the C++ Wing-Gong checker on an exported history; True =
+    linearizable. In-process by default; ``binary`` forces the CLI."""
+    if binary is None:
+        from madraft_tpu import simcore
+
+        if simcore.available():
+            return simcore.check_linearizable("\n".join(lines) + "\n")
     binary = pathlib.Path(binary or _REPO / "build" / "madtpu_lincheck")
     with tempfile.NamedTemporaryFile(
         "w", suffix=".txt", prefix="madtpu_hist_", delete=False
@@ -411,8 +425,14 @@ def replay_shardkv_on_simcore(
     workdir: Optional[pathlib.Path] = None,
 ) -> dict:
     """Run the C++ shardkv replayer on a schedule; returns its JSON report.
-    The bug mode rides in the schedule file; the binary sets the env-gated
-    injection (shardkv.h bug_mode()) itself."""
+    The bug mode rides in the schedule text; the C++ side sets (and
+    restores) the env-gated injection (shardkv.h bug_mode()) itself.
+    In-process by default; ``binary`` forces the CLI subprocess."""
+    if binary is None:
+        from madraft_tpu import simcore
+
+        if simcore.available():
+            return simcore.replay_shardkv_schedule(schedule.dumps())
     binary = pathlib.Path(binary or _REPO / "build" / "madtpu_shardkv_replay")
     with tempfile.NamedTemporaryFile(
         "w", suffix=".txt", prefix="madtpu_skv_replay_",

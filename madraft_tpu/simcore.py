@@ -1,0 +1,117 @@
+"""ctypes bindings to the C++ deterministic-simulation runtime (libmadtpu).
+
+The in-process end of the TPU<->C++ differential bridge (SURVEY.md §7
+architecture item 4 calls for Python<->C++ bindings; pybind11 is not in the
+build image, so the C ABI of ``cpp/tools/capi.cpp`` is bound with ctypes).
+Each call runs a full simcore simulation to completion on the calling
+thread — no subprocess fork/exec per replay, which matters when a fuzzing
+loop cross-checks many violating clusters. ``madraft_tpu.bridge`` routes
+through these bindings when the shared library is loadable and falls back
+to the CLI binaries otherwise.
+
+Thread-safety: the C API serializes every call behind one mutex (the replay
+knobs ride in process-global env vars, and concurrent setenv/getenv is
+undefined behavior) — concurrent Python threads are safe but get no
+parallelism; use multiple processes for parallel replays.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import json
+import pathlib
+import subprocess
+from typing import Optional
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+_LIB_PATH = _REPO / "build" / "libmadtpu.so"
+_OUT_CAP = 4096
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build_lib() -> None:
+    build = _REPO / "build"
+    build.mkdir(exist_ok=True)
+    # serialize concurrent builders (pytest workers, parallel bridge runs):
+    # two cmake/ninja invocations in one build dir corrupt each other
+    with open(build / ".madtpu_build.lock", "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        for cmd in (
+            ["cmake", "-S", str(_REPO / "cpp"), "-B", str(build), "-G",
+             "Ninja"],
+            ["ninja", "-C", str(build), "madtpu"],
+        ):
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"{' '.join(cmd)} failed:\n{proc.stdout[-1000:]}\n"
+                    f"{proc.stderr[-3000:]}"
+                )
+
+
+def load(build_if_missing: bool = True) -> ctypes.CDLL:
+    """Load (building on demand) and memoize the shared library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    srcs = list((_REPO / "cpp").rglob("*.cpp")) + list((_REPO / "cpp").rglob("*.h"))
+    # no cpp tree (e.g. a deployed wheel): use whatever library exists
+    newest = max((p.stat().st_mtime for p in srcs), default=0.0)
+    if build_if_missing and srcs and (
+        not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < newest
+    ):
+        _build_lib()
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    for name in ("madtpu_replay_run", "madtpu_shardkv_replay_run"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        fn.restype = ctypes.c_int
+    lib.madtpu_lincheck_run.argtypes = [ctypes.c_char_p]
+    lib.madtpu_lincheck_run.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    """True if the bindings can be used (library present or buildable)."""
+    try:
+        load()
+        return True
+    except (RuntimeError, OSError, ValueError):
+        return False
+
+
+def _run(fn_name: str, schedule_text: str) -> dict:
+    lib = load()
+    out = ctypes.create_string_buffer(_OUT_CAP)
+    rc = getattr(lib, fn_name)(schedule_text.encode(), out, _OUT_CAP)
+    if rc == -1:
+        raise ValueError(f"{fn_name}: bad schedule")
+    if rc == -2:
+        raise RuntimeError(f"{fn_name}: sim deadlocked")
+    if rc < 0:
+        raise RuntimeError(f"{fn_name}: rc={rc}")
+    return json.loads(out.value.decode())
+
+
+def replay_schedule(schedule_text: str) -> dict:
+    """Replay a raw-raft fault schedule in process -> the JSON report dict
+    (same schema as the madtpu_replay CLI)."""
+    return _run("madtpu_replay_run", schedule_text)
+
+
+def replay_shardkv_schedule(schedule_text: str) -> dict:
+    """Replay a shardkv config+fault schedule in process -> JSON report
+    (same schema as the madtpu_shardkv_replay CLI). The bug mode rides in
+    the schedule text and is restored after the run."""
+    return _run("madtpu_shardkv_replay_run", schedule_text)
+
+
+def check_linearizable(history_text: str) -> bool:
+    """Run the Wing-Gong checker on a history (lincheck format) in process."""
+    rc = load().madtpu_lincheck_run(history_text.encode())
+    if rc < 0:
+        raise ValueError("bad history text")
+    return rc == 1

@@ -1,0 +1,48 @@
+"""The CLI front door (python -m madraft_tpu): fuzz -> flag a violating
+cluster -> replay it exactly -> bridge its schedule to the C++ runtime.
+One JSON line per command; exit code 1 when violations were found."""
+
+import contextlib
+import io
+import json
+
+import pytest
+
+from madraft_tpu.__main__ import main
+
+
+def run(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(argv)
+    return rc, json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_cli_fuzz_replay_bridge_loop():
+    rc, out = run(["fuzz", "--clusters", "48", "--ticks", "256", "--storm"])
+    assert rc == 0 and out["violating"] == 0, out
+
+    rc, out = run(["fuzz", "--clusters", "48", "--ticks", "256", "--storm",
+                   "--majority-override", "2"])
+    assert rc == 1 and out["violating"] > 0
+    bad = out["violating_clusters"][0]
+
+    rc, out = run(["replay", "--cluster", str(bad), "--ticks", "256",
+                   "--storm", "--majority-override", "2"])
+    assert rc == 1 and out["violations"] != 0, out
+
+    from madraft_tpu import simcore
+
+    if not simcore.available():
+        pytest.skip("libmadtpu.so not buildable here")
+    rc, out = run(["bridge", "--cluster", str(bad), "--ticks", "256",
+                   "--storm", "--majority-override", "2"])
+    assert out["classes_match"], out
+
+
+def test_cli_service_layers():
+    rc, out = run(["kv-fuzz", "--clusters", "32", "--ticks", "256", "--storm"])
+    assert rc == 0 and out["violating"] == 0 and out["acked_ops_mean"] > 0
+
+    rc, out = run(["shardkv-fuzz", "--clusters", "8", "--ticks", "440"])
+    assert rc == 0 and out["violating"] == 0 and out["installs_mean"] > 0
