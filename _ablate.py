@@ -11,12 +11,14 @@ RETURN = (
     "        log_val=log_val, log_len=log_len, base=base, snap_term=snap_term,\n"
     "        commit=commit, votes=votes, next_idx=next_idx, match_idx=match_idx)\n"
 )
+# Round-3 phase order: responses deliver BEFORE requests (see step.py).
 ANCHORS = [
-    ("faults-only", "    # ------------------------------------------- deliver: install-snapshot"),
+    ("faults-only", "    # ---------------------------------------------------- deliver: RV responses"),
+    ("+responses", "    # ------------------------------------------- deliver: install-snapshot"),
     ("+sn-deliver", "    # ----------------------------------------------------- deliver: RV requests"),
     ("+rv-deliver", "    # ----------------------------------------------------- deliver: AE requests"),
-    ("+ae-deliver", "    # ---------------------------------------------------- deliver: RV responses"),
-    ("+responses", "    # ------------------------------------------------- timers: election timeout"),
+    ("+ae-deliver", "    # Candidate -> leader on majority"),
+    ("+win", "    # ------------------------------------------------- timers: election timeout"),
     ("+timers", "    # --------------------------------------- client command injection at leaders"),
     ("+inject", "    # -------------------------------------------- leader heartbeat / replication"),
     ("+heartbeat", "    # ------------------------------------------------------------ commit advance"),

@@ -22,9 +22,12 @@ tests.rs:438-493) and keep serving unaffected shards mid-migration
   The pull payload itself (per-shard state + dup table) is modeled as riding
   the INSTALL entry via a per-group staging buffer filled by the inter-group
   pull response (the tensor analogue of the RPC payload).
-- Inter-group traffic (pull request / pull response / ack) uses per-
-  (dst_group, src_group, shard) mailbox tensors with the same delivery-tick +
-  loss semantics as the in-group network.
+- Inter-group traffic (pull request / pull response / GC-confirm poll) uses
+  per-(dst_group, src_group, shard) mailbox tensors with the same
+  delivery-tick + loss semantics as the in-group network. GC is PULL-driven:
+  the FROZEN holder polls the gain-config owner "did the install land?" and
+  deletes on confirmation — self-contained per frozen copy (derived from the
+  static schedule + persisted configs), so no push-ack window can be missed.
 
 Oracles (all on-device reductions, sticky violation bits):
 - A **truth walker** per group: a canonical service state machine advanced
@@ -39,7 +42,7 @@ Oracles (all on-device reductions, sticky violation bits):
   dual ownership impossible in a correct implementation.
 - **Storage bound** (VIOLATION_SHARD_STORAGE): at most one extra (frozen)
   copy of a shard may exist during migration; frozen copies must disappear
-  after ack+delete — challenge 1's bound as an invariant.
+  after GC confirm + delete — challenge 1's bound as an invariant.
 - Bug modes validate the oracles: ``bug_skip_freeze`` (a lost shard keeps
   serving at the nodes) and ``bug_drop_dup_table`` (INSTALL resets the dup
   table, so migrated-away retries double-apply).
@@ -114,12 +117,13 @@ class ShardKvConfig:
     p_retry: float = 0.5        # pending clerk re-submits this tick
     p_cfg_learn: float = 0.3    # clerk/leader learns a newer config this tick
     p_pull: float = 0.4         # leader (re)sends a pull for a PULLING shard
-    p_ack: float = 0.4          # leader (re)sends the post-install ack (the
-    #                             GC trigger; low values stretch the window
-    #                             where the old owner still holds a copy)
+    p_ack: float = 0.4          # a FROZEN holder polls the gain-config owner
+    #                             for GC confirmation (low values stretch the
+    #                             window where the old copy survives)
     pull_delay_min: int = 1
     pull_delay_max: int = 3
-    pull_loss: float = 0.1      # inter-group message loss (pulls AND acks)
+    pull_loss: float = 0.1      # inter-group message loss (pulls AND
+    #                             GC-confirm polls)
     apply_max: int = 4          # apply-machine entries per node per tick
     walk_max: int = 6           # truth-walker entries per group per tick
     # Oracle-validation bug modes (False = correct service).
@@ -200,8 +204,18 @@ class ShardKvState(NamedTuple):
     pull_rsp_hash: jax.Array
     pull_rsp_count: jax.Array
     pull_rsp_last_seq: jax.Array  # [dst, src, NS, NC]
-    ack_t: jax.Array              # dst(=old owner) <- src(=new owner)
-    ack_cfg: jax.Array
+    # GC confirm protocol (challenge 1): the FROZEN HOLDER drives its own
+    # deletion — it derives the config it froze at from the static schedule
+    # plus its persisted config, and polls that config's owner "installed?";
+    # the answer derives from the owner's persisted state alone. Nothing to
+    # book-keep at the new owner, so no ack window can be missed (the
+    # soak-found leak: push-style acks retried only while the new owner
+    # stayed in its gain config — all lost => the frozen copy leaked forever
+    # and a later re-gain deadlocked on the regain gate).
+    gcq_req_t: jax.Array          # [dst(gain-cfg owner), src(holder), NS]
+    gcq_req_cfg: jax.Array
+    gcq_rsp_t: jax.Array          # [dst(holder), src(gain-cfg owner), NS]
+    gcq_rsp_cfg: jax.Array
     # --- clerks [NC] ---
     clerk_seq: jax.Array
     clerk_out: jax.Array          # bool
@@ -356,7 +370,8 @@ def init_shardkv_cluster(
         pull_rsp_t=zggs, pull_rsp_cfg=zggs,
         pull_rsp_hash=zggs, pull_rsp_count=zggs,
         pull_rsp_last_seq=jnp.zeros((g, g, ns, nc), I32),
-        ack_t=zggs, ack_cfg=zggs,
+        gcq_req_t=zggs, gcq_req_cfg=zggs,
+        gcq_rsp_t=zggs, gcq_rsp_cfg=zggs,
         clerk_seq=jnp.zeros((nc,), I32),
         clerk_out=jnp.zeros((nc,), jnp.bool_),
         clerk_shard=jnp.zeros((nc,), I32),
@@ -708,10 +723,10 @@ def shardkv_step(
     # Storage (challenge 1): deleted means DELETED — a node holding state for
     # a shard whose phase is ABSENT is a GC leak (the bytes challenge 1
     # bounds). Chained migrations make any per-tick bound on frozen-copy
-    # counts unsound (acks lag arbitrarily), so eventual GC completion is
-    # asserted at quiesce by the tests via the report's frozen_left/deletes
-    # fields — the analogue of the reference's end-of-test total-storage
-    # assertion (shardkv/tests.rs:477-488).
+    # counts unsound (confirm polls lag arbitrarily), so eventual GC
+    # completion is asserted at quiesce by the tests via the report's
+    # frozen_left/deletes fields — the analogue of the reference's
+    # end-of-test total-storage assertion (shardkv/tests.rs:477-488).
     leak = s.alive[..., None] & (phase == ABSENT) & (
         (key_hash != 0) | (key_count != 0)
     )
@@ -738,7 +753,7 @@ def shardkv_step(
     l_last_seq = lead_view(last_seq)  # [G, NS, NC]
 
     kp = jax.random.split(jax.random.fold_in(key, _S_PULL), 4)
-    knet = jax.random.split(jax.random.fold_in(key, _S_NET_PULL), 5)
+    knet = jax.random.split(jax.random.fold_in(key, _S_NET_PULL), 7)
 
     # Deliver pull requests: src leader answers for FROZEN shards at the
     # requested config with its own (frozen) state.
@@ -782,11 +797,59 @@ def shardkv_step(
     )
     pull_rsp_t = jnp.where(rsp_arr, 0, pull_rsp_t)
 
-    # Deliver acks at the old owner: leader appends DELETE (guarded at apply).
-    ack_arr = st.ack_t == t  # [old_owner(dst), new_owner(src), NS]
-    ack_del = jnp.any(ack_arr, axis=1)  # [G, NS] old owner should delete
-    ack_del_cfg = jnp.max(jnp.where(ack_arr, st.ack_cfg, 0), axis=1)
-    ack_t = jnp.where(ack_arr, 0, st.ack_t)
+    # The config each group's CURRENT frozen copy of shard s dates from:
+    # the latest config c <= l_cfg where the schedule moved s away from the
+    # group. Derived from the static schedule + the leader's persisted
+    # config — the regain gate guarantees at most one frozen epoch per
+    # (group, shard) at a time, so "latest" is THE epoch.
+    away = (
+        (st.cfg_owner[None, :-1] == my_gv[:, None, None])
+        & (st.cfg_owner[None, 1:] != my_gv[:, None, None])
+    )  # [G, NCFG-1, NS]; entry c-1 = "froze when adopting config c"
+    cnum = jnp.arange(1, kcfg.n_configs, dtype=I32)[None, :, None]
+    freeze_cfg = jnp.max(
+        jnp.where(away & (cnum <= l_cfg[:, None, None]), cnum, 0), axis=1
+    )  # [G, NS]; 0 = never froze
+
+    # Deliver GC confirms at the holder FIRST (responses before requests —
+    # the step.py ordering principle): the leader appends DELETE, but only
+    # when the confirmed epoch matches the CURRENT freeze epoch, so an
+    # in-flight confirm from an older epoch can never delete a newer frozen
+    # copy whose own migration is still in progress.
+    grsp_arr = st.gcq_rsp_t == t  # [dst(holder), src, NS]
+    ack_del = jnp.any(
+        grsp_arr & (st.gcq_rsp_cfg == freeze_cfg[:, None, :]), axis=1
+    ) & (l_phase == FROZEN)  # [G, NS]
+    ack_del_cfg = freeze_cfg
+    gcq_rsp_t = jnp.where(grsp_arr, 0, st.gcq_rsp_t)
+
+    # Deliver GC-confirm requests at the gain-config owner: its leader
+    # answers "installed" iff its PERSISTED state proves the (s, c) install
+    # applied — l_cfg > c (config advance gates on pulls complete, and the
+    # CONFIG c+1 entry follows the INSTALL in its log), or l_cfg == c with
+    # the shard OWNED. Keep-oldest on the response slot (an in-flight
+    # confirm is never clobbered by a fresh one).
+    gq_arr = st.gcq_req_t == t  # [dst(gain owner), src(holder), NS]
+    installed = (
+        (l_cfg[:, None, None] > st.gcq_req_cfg)
+        | (
+            (l_cfg[:, None, None] == st.gcq_req_cfg)
+            & ((l_phase == OWNED)[:, None, :])
+        )
+    ) & lead_any[:, None, None]
+    gdelay = jax.random.randint(
+        knet[3], (g, g, ns), kcfg.pull_delay_min, kcfg.pull_delay_max + 1,
+        dtype=I32,
+    )
+    glost = jax.random.bernoulli(knet[4], kcfg.pull_loss, (g, g, ns))
+    send_grsp = (
+        (gq_arr & installed & ~glost).transpose(1, 0, 2) & (gcq_rsp_t == 0)
+    )
+    gcq_rsp_t = jnp.where(send_grsp, t + gdelay, gcq_rsp_t)
+    gcq_rsp_cfg = jnp.where(
+        send_grsp, st.gcq_req_cfg.transpose(1, 0, 2), st.gcq_rsp_cfg
+    )
+    gcq_req_t = jnp.where(gq_arr, 0, st.gcq_req_t)
 
     # ------------------------------------------- leader protocol transitions
     # (a) poll the controller: append CONFIG(node_cfg+1) once migrations for
@@ -794,9 +857,10 @@ def shardkv_step(
     poll = jax.random.bernoulli(kp[0], kcfg.p_cfg_learn, (g,))
     # Advance gate: all pulls for the current config done, AND no FROZEN
     # shard that the next config would hand back to us — its frozen copy
-    # still serves the older migration; the DELETE (driven by the new
-    # owner's ack) must land first. No circular wait: the dest's install
-    # only needs the frozen copy to exist, not our config progress.
+    # still serves the older migration; the DELETE (driven by our own
+    # GC-confirm poll of the gain-config owner) must land first. No circular
+    # wait: the dest's install only needs the frozen copy to exist, not our
+    # config progress.
     next_owner_l = st.cfg_owner[
         jnp.clip(l_cfg + 1, 0, kcfg.n_configs - 1)
     ]  # [G, NS]
@@ -825,22 +889,38 @@ def shardkv_step(
     pull_req_cfg = jnp.where(
         send_req, l_cfg[:, None, None], st.pull_req_cfg
     )
-    # (c) acks for shards owned in the current config that were migrated in
-    #     (previous owner differs): idempotent retries; DELETE guards dedup.
-    migrated_in = (l_phase == OWNED) & (prev_owner_l != my_gv[:, None])
-    ack_draw = jax.random.bernoulli(kp[3], kcfg.p_ack, (g, ns))
-    do_ack = migrated_in & ack_draw & lead_any[:, None]
-    # acks ride the same adversarial network as pulls: lossy + 1..3-tick
-    # delays (idempotent retries; the DELETE apply guard dedups), so the GC
-    # path sees reordering too (shardkv/tests.rs:438-493 under unreliable)
-    delay3 = jax.random.randint(
-        knet[3], (g, g, ns), kcfg.pull_delay_min, kcfg.pull_delay_max + 1,
+    # (c) GC-confirm polling: every FROZEN holder asks the gain-config owner
+    #     whether the install landed (see the delivery comment above);
+    #     retried forever at p_ack over the same lossy/delayed network, and
+    #     self-contained — no per-migration bookkeeping at the new owner, so
+    #     no ack window can be missed (the soak-found leak).
+    gain_owner = jnp.sum(
+        jnp.where(
+            jnp.arange(kcfg.n_configs, dtype=I32)[None, :, None]
+            == freeze_cfg[:, None, :],
+            st.cfg_owner[None, :, :], 0,
+        ),
+        axis=1,
+    )  # [G, NS]: owner at the holder's freeze config
+    gc_draw = jax.random.bernoulli(kp[3], kcfg.p_ack, (g, ns))
+    do_gcq = (
+        (l_phase == FROZEN) & (freeze_cfg > 0) & gc_draw & lead_any[:, None]
+    )
+    gtgt_oh = gain_owner[:, None, :] == my_gv[None, :, None]  # [holder, dst?, NS]
+    gdelay2 = jax.random.randint(
+        knet[5], (g, g, ns), kcfg.pull_delay_min, kcfg.pull_delay_max + 1,
         dtype=I32,
     )
-    lost3 = jax.random.bernoulli(knet[4], kcfg.pull_loss, (g, g, ns))
-    send_ack = (do_ack[:, None, :] & tgt_oh).transpose(1, 0, 2) & ~lost3
-    ack_t = jnp.where(send_ack, t + delay3, ack_t)
-    ack_cfg = jnp.where(send_ack, l_cfg[None, :, None], st.ack_cfg)
+    glost2 = jax.random.bernoulli(knet[6], kcfg.pull_loss, (g, g, ns))
+    # keep-oldest: a poll in flight is not re-stamped by the next draw
+    # (otherwise p_ack ~ 1/delay re-sends could starve delivery forever)
+    send_gcq = (
+        (do_gcq[:, None, :] & gtgt_oh).transpose(1, 0, 2) & ~glost2
+        & (gcq_req_t == 0)
+    )
+    gcq_req_t = jnp.where(send_gcq, t + gdelay2, gcq_req_t)
+    # [dst(gain owner), src(holder), NS]: the cfg is the HOLDER's epoch
+    gcq_req_cfg = jnp.where(send_gcq, freeze_cfg[None, :, :], st.gcq_req_cfg)
 
     # --------------------------------------------------------------- clerks
     kc = jax.random.split(jax.random.fold_in(key, _S_CLERK), 6)
@@ -1010,7 +1090,8 @@ def shardkv_step(
         pull_rsp_t=pull_rsp_t, pull_rsp_cfg=pull_rsp_cfg,
         pull_rsp_hash=pull_rsp_hash, pull_rsp_count=pull_rsp_count,
         pull_rsp_last_seq=pull_rsp_last_seq,
-        ack_t=ack_t, ack_cfg=ack_cfg,
+        gcq_req_t=gcq_req_t, gcq_req_cfg=gcq_req_cfg,
+        gcq_rsp_t=gcq_rsp_t, gcq_rsp_cfg=gcq_rsp_cfg,
         clerk_seq=clerk_seq, clerk_out=clerk_out,
         clerk_shard=clerk_shard, clerk_kind=clerk_kind, clerk_cfg=clerk_cfg,
         clerk_acked=clerk_acked,

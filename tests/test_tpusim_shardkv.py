@@ -135,6 +135,30 @@ def test_shardkv_missed_configs_catch_up():
     assert (rep.acked_ops > 0).all()
 
 
+def test_shardkv_gc_completes_under_storm():
+    """Round-3 regression (soak-found): push-style install acks were retried
+    only while the new owner stayed in its gain config, so a crash/loss storm
+    could leak a frozen copy forever and deadlock every later config that
+    re-gained the shard (the regain gate). GC is now pull-driven — the FROZEN
+    holder polls the gain-config owner and deletes on confirmation — so a
+    LONG schedule under a storm must complete: every deployment near the
+    final config, installs == deletes, (almost) no frozen copies left."""
+    storm = RAFT.replace(p_crash=0.01, p_restart=0.2, max_dead=1,
+                         loss_prob=0.1)
+    kcfg = SKV.replace(n_configs=16, cfg_interval=70)
+    rep = shardkv_fuzz(storm, kcfg, seed=424, n_clusters=12, n_ticks=1800)
+    assert rep.n_violating == 0
+    assert (rep.final_cfg >= kcfg.n_configs - 2).all(), (
+        f"schedule stalled: final configs {np.sort(rep.final_cfg)}"
+    )
+    assert (rep.deletes == rep.installs).all(), "GC must keep up with installs"
+    # a handful of frozen copies may legitimately serve migrations still in
+    # flight at the cutoff; a LEAK would accumulate dozens over 16 configs
+    assert rep.frozen_left.sum() <= kcfg.n_shards, (
+        f"frozen copies leaked: {rep.frozen_left.sum()}"
+    )
+
+
 def test_shardkv_serve_frozen_oracle_fires():
     """A server that skips the ownership check for reads (serving Gets from a
     surrendered FROZEN copy / a GC'd shard) must trip the per-shard interval
