@@ -1,0 +1,94 @@
+"""Config-space fuzz campaign: safety + liveness across the knob and shape
+grid (the wide-net companion to the targeted test suite; ~6 min on CPU).
+
+Covers: a 16-combo loss x crash x repartition sweep in ONE compiled program;
+raft shape corners (3/4/5/7 nodes, ae_max 1..8, log_cap 32..128,
+compact_every 1..48, leader-targeted + asymmetric cuts); kv extremes
+(apply_max=1 backlog, 8 hot clients on 2 keys); shardkv topologies
+(2..4 groups, 4..10 shards, 3..5 nodes/group). Exits non-zero on any
+violation OR liveness anomaly (a config that stops committing / stalls its
+schedule), which is how round 3's response-starvation and GC-leak bugs were
+found. Usage: python _campaign.py  (set MADTPU_PLATFORM to override the
+backend; defaults to CPU — the point is breadth, not throughput).
+"""
+import os
+import time
+
+import jax
+
+jax.config.update("jax_platforms", os.environ.get("MADTPU_PLATFORM", "cpu"))
+import jax.numpy as jnp
+import numpy as np
+
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.engine import fuzz, make_sweep_fn, report
+from madraft_tpu.tpusim.kv import KvConfig, kv_fuzz
+from madraft_tpu.tpusim.shardkv import ShardKvConfig, shardkv_fuzz
+
+t0 = time.time()
+fails = []
+
+def check(name, ok, detail=""):
+    print(f"[{time.time()-t0:6.0f}s] {'OK ' if ok else 'FAIL'} {name} {detail}", flush=True)
+    if not ok: fails.append(name)
+
+# 1. knob grid in one program: loss x crash x repartition
+base = SimConfig(n_nodes=5, p_client_cmd=0.2, p_restart=0.2, max_dead=2, p_heal=0.05)
+combos = [(l, c, r) for l in (0.0, 0.1, 0.3, 0.5) for c in (0.0, 0.02) for r in (0.0, 0.05)]
+per = 24
+n = len(combos) * per
+kn = base.knobs()
+loss = jnp.repeat(jnp.asarray([x[0] for x in combos], jnp.float32), per)
+crash = jnp.repeat(jnp.asarray([x[1] for x in combos], jnp.float32), per)
+rep_p = jnp.repeat(jnp.asarray([x[2] for x in combos], jnp.float32), per)
+kn = kn._replace(loss_prob=loss, p_crash=crash, p_repartition=rep_p)
+r = report(make_sweep_fn(base, kn, n, 1024)(77))
+check("grid 16-combo sweep", r.n_violating == 0, f"viol={r.n_violating}")
+for i, (l, c, rp) in enumerate(combos):
+    com = r.committed[i*per:(i+1)*per]
+    if l <= 0.3:
+        check(f"  liveness loss={l} crash={c} rep={rp}", (com > 0).all(),
+              f"commit0={int((com==0).sum())}/{per} mean={com.mean():.0f}")
+
+# 2. shape corners
+for cfg, ticks in [
+    (SimConfig(n_nodes=3, p_client_cmd=0.3, loss_prob=0.2, p_crash=0.02, p_restart=0.2, max_dead=1), 1024),
+    (SimConfig(n_nodes=7, p_client_cmd=0.2, loss_prob=0.1, p_crash=0.02, p_restart=0.2, max_dead=3, p_repartition=0.03, p_heal=0.06), 768),
+    (SimConfig(n_nodes=5, ae_max=1, p_client_cmd=0.3, loss_prob=0.1), 768),
+    (SimConfig(n_nodes=5, ae_max=8, p_client_cmd=0.4, loss_prob=0.1, p_crash=0.02, p_restart=0.2, max_dead=2), 768),
+    (SimConfig(n_nodes=5, log_cap=32, compact_every=4, p_client_cmd=0.3, loss_prob=0.1, p_crash=0.02, p_restart=0.2, max_dead=2), 768),
+    (SimConfig(n_nodes=5, log_cap=128, compact_every=48, p_client_cmd=0.4, loss_prob=0.1), 768),
+    (SimConfig(n_nodes=5, compact_every=1, p_client_cmd=0.3, loss_prob=0.15, p_crash=0.02, p_restart=0.2, max_dead=2), 768),
+    (SimConfig(n_nodes=4, p_client_cmd=0.2, loss_prob=0.2, p_leader_part=0.03, p_asym_cut=0.08, p_heal=0.05), 768),
+]:
+    rr = fuzz(cfg, seed=88, n_clusters=48, n_ticks=ticks)
+    tag = f"n={cfg.n_nodes} ae={cfg.ae_max} cap={cfg.log_cap} ce={cfg.compact_every}"
+    check(f"shape {tag}", rr.n_violating == 0, f"viol={rr.n_violating} commit_mean={rr.committed.mean():.0f}")
+    check(f"  live {tag}", (rr.committed > 0).all(), f"zero={int((rr.committed==0).sum())}")
+
+# 3. kv extremes
+kcfg_base = SimConfig(n_nodes=5, p_client_cmd=0.0, compact_at_commit=False,
+                      log_cap=64, compact_every=16, loss_prob=0.15,
+                      p_crash=0.02, p_restart=0.2, max_dead=2, p_repartition=0.03, p_heal=0.06)
+for kv, ticks in [
+    (KvConfig(apply_max=1, p_retry=1.0, p_get=0.5), 768),
+    (KvConfig(n_clients=8, n_keys=2, p_op=0.8, p_retry=0.9, p_get=0.4), 768),
+]:
+    rr = kv_fuzz(kcfg_base, kv, seed=88, n_clusters=32, n_ticks=ticks)
+    check(f"kv nc={kv.n_clients} am={kv.apply_max}", rr.n_violating == 0,
+          f"viol={rr.n_violating} acked={rr.acked_ops.mean():.0f}")
+
+# 4. shardkv shapes
+for g, ns, nodes in [(2, 4, 3), (4, 10, 3), (3, 10, 5)]:
+    raft = SimConfig(n_nodes=nodes, p_client_cmd=0.0, compact_at_commit=False,
+                     log_cap=64, compact_every=16, loss_prob=0.1,
+                     p_crash=0.01, p_restart=0.2, max_dead=1)
+    sk = ShardKvConfig(n_groups=g, n_shards=ns, n_configs=10, cfg_interval=60, p_get=0.3)
+    rr = shardkv_fuzz(raft, sk, seed=88, n_clusters=10, n_ticks=1100)
+    check(f"shardkv g={g} ns={ns} n={nodes}", rr.n_violating == 0,
+          f"viol={rr.n_violating} cfg_min={rr.final_cfg.min()} inst={rr.installs.sum()} del={rr.deletes.sum()}")
+    check(f"  progress g={g} ns={ns}", (rr.final_cfg >= sk.n_configs - 3).all(),
+          f"final={np.sort(rr.final_cfg).tolist()}")
+
+print("CAMPAIGN DONE", "FAILURES:" if fails else "all clean", fails)
+raise SystemExit(1 if fails else 0)

@@ -486,6 +486,27 @@ def shardkv_step(
     cl_ids = jnp.arange(nc, dtype=I32)
     clerk_get_obs = st.clerk_get_obs
     gids_v = jnp.arange(g, dtype=I32)
+
+    # away[g, c-1, s]: the schedule moved s away from g when adopting config
+    # c. freeze_epoch(view) = the latest such c <= the applier's config view:
+    # THE live freeze epoch per (applier, shard) (the regain gate guarantees
+    # at most one). DELETE entries are applied ONLY at their own epoch, so a
+    # stale-epoch DELETE — e.g. appended by a replay-lagged leader whose
+    # applied view still showed an older freeze — is a no-op instead of
+    # destroying a newer frozen copy.
+    away_gs = (
+        (st.cfg_owner[None, :-1] == gids_v[:, None, None])
+        & (st.cfg_owner[None, 1:] != gids_v[:, None, None])
+    )  # [G, NCFG-1, NS]
+    cnum_v = jnp.arange(1, kcfg.n_configs, dtype=I32)[None, :, None]
+
+    def freeze_epoch(cfg_view):
+        """[G] -> [G, NS] or [G, N] -> [G, N, NS]: latest away-config <= view."""
+        if cfg_view.ndim == 1:
+            elig = away_gs & (cnum_v <= cfg_view[:, None, None])
+            return jnp.max(jnp.where(elig, cnum_v, 0), axis=1)
+        elig = away_gs[:, None] & (cnum_v[:, None] <= cfg_view[..., None, None])
+        return jnp.max(jnp.where(elig, cnum_v[:, None], 0), axis=2)
     for _ in range(kcfg.apply_max):
         can = s.alive & (applied < s.commit)  # [G, N]
         pos = _slot(applied + 1, cap)
@@ -579,8 +600,10 @@ def shardkv_step(
             )
         phase = jnp.where(inst_upd, OWNED, phase)
 
-        # DELETE(s, c): drop the frozen copy (challenge-1 GC).
-        is_del = can & (kind == _DELETE)
+        # DELETE(s, c): drop the frozen copy (challenge-1 GC) — only at its
+        # own freeze epoch (see the freeze_epoch comment above).
+        fe_at = jnp.sum(jnp.where(sh_oh, freeze_epoch(node_cfg), 0), axis=-1)
+        is_del = can & (kind == _DELETE) & (cfg_i == fe_at)
         del_upd = sh_oh & is_del[..., None] & (phase == FROZEN)
         phase = jnp.where(del_upd, ABSENT, phase)
         key_hash = jnp.where(del_upd, 0, key_hash)
@@ -695,7 +718,9 @@ def shardkv_step(
         w_phase = jnp.where(inst_upd, OWNED, w_phase)
         installs_done += jnp.sum(inst_upd, dtype=I32)
 
-        is_del = canw & (kind == _DELETE)
+        # epoch-guarded like the node apply machines (freeze_epoch comment)
+        fe_w_at = jnp.sum(jnp.where(sh_oh, freeze_epoch(w_cfg), 0), axis=-1)
+        is_del = canw & (kind == _DELETE) & (cfg_i == fe_w_at)
         del_upd = sh_oh & is_del[:, None] & (w_phase == FROZEN)
         w_phase = jnp.where(del_upd, ABSENT, w_phase)
         w_hash = jnp.where(del_upd, 0, w_hash)
@@ -753,7 +778,19 @@ def shardkv_step(
     l_last_seq = lead_view(last_seq)  # [G, NS, NC]
 
     kp = jax.random.split(jax.random.fold_in(key, _S_PULL), 4)
-    knet = jax.random.split(jax.random.fold_in(key, _S_NET_PULL), 7)
+    knet = jax.random.split(jax.random.fold_in(key, _S_NET_PULL), 4)
+
+    def _net_pair(k, shape):
+        """(delay, lost) for a batch of inter-group sends from ONE u32 word
+        each (the step.py _net_draws packing: loss decided by the top 24
+        bits, delay by the low byte)."""
+        w = jax.random.bits(k, shape)
+        lost = (
+            (w >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+        ) < kcfg.pull_loss
+        span = max(1, kcfg.pull_delay_max + 1 - kcfg.pull_delay_min)
+        delay = kcfg.pull_delay_min + ((w & 0xFF) % span).astype(I32)
+        return delay, lost
 
     # Deliver pull requests: src leader answers for FROZEN shards at the
     # requested config with its own (frozen) state.
@@ -761,11 +798,7 @@ def shardkv_step(
     src_frozen = (l_phase == FROZEN)[None, :, :]  # src's leader view
     src_cfg_ok = (l_cfg[None, :, None] >= st.pull_req_cfg) & lead_any[None, :, None]
     answer = req_arr & src_frozen & src_cfg_ok
-    delay = jax.random.randint(
-        knet[0], (g, g, ns), kcfg.pull_delay_min, kcfg.pull_delay_max + 1,
-        dtype=I32,
-    )
-    lost = jax.random.bernoulli(knet[1], kcfg.pull_loss, (g, g, ns))
+    delay, lost = _net_pair(knet[0], (g, g, ns))
     send_rsp = answer & ~lost
     pull_rsp_t = jnp.where(send_rsp, t + delay, st.pull_rsp_t)
     pull_rsp_cfg = jnp.where(send_rsp, st.pull_req_cfg, st.pull_rsp_cfg)
@@ -797,19 +830,9 @@ def shardkv_step(
     )
     pull_rsp_t = jnp.where(rsp_arr, 0, pull_rsp_t)
 
-    # The config each group's CURRENT frozen copy of shard s dates from:
-    # the latest config c <= l_cfg where the schedule moved s away from the
-    # group. Derived from the static schedule + the leader's persisted
-    # config — the regain gate guarantees at most one frozen epoch per
-    # (group, shard) at a time, so "latest" is THE epoch.
-    away = (
-        (st.cfg_owner[None, :-1] == my_gv[:, None, None])
-        & (st.cfg_owner[None, 1:] != my_gv[:, None, None])
-    )  # [G, NCFG-1, NS]; entry c-1 = "froze when adopting config c"
-    cnum = jnp.arange(1, kcfg.n_configs, dtype=I32)[None, :, None]
-    freeze_cfg = jnp.max(
-        jnp.where(away & (cnum <= l_cfg[:, None, None]), cnum, 0), axis=1
-    )  # [G, NS]; 0 = never froze
+    # The config each group's CURRENT frozen copy of shard s dates from
+    # (freeze_epoch comment above; leader's applied view). 0 = never froze.
+    freeze_cfg = freeze_epoch(l_cfg)  # [G, NS]
 
     # Deliver GC confirms at the holder FIRST (responses before requests —
     # the step.py ordering principle): the leader appends DELETE, but only
@@ -837,11 +860,7 @@ def shardkv_step(
             & ((l_phase == OWNED)[:, None, :])
         )
     ) & lead_any[:, None, None]
-    gdelay = jax.random.randint(
-        knet[3], (g, g, ns), kcfg.pull_delay_min, kcfg.pull_delay_max + 1,
-        dtype=I32,
-    )
-    glost = jax.random.bernoulli(knet[4], kcfg.pull_loss, (g, g, ns))
+    gdelay, glost = _net_pair(knet[1], (g, g, ns))
     send_grsp = (
         (gq_arr & installed & ~glost).transpose(1, 0, 2) & (gcq_rsp_t == 0)
     )
@@ -879,11 +898,7 @@ def shardkv_step(
     prev_owner_l = st.cfg_owner[jnp.clip(l_cfg - 1, 0, kcfg.n_configs - 1)]  # [G, NS]
     do_pull = want_pull & pull_draw
     tgt_oh = prev_owner_l[:, None, :] == my_gv[None, :, None]  # [dst, src, NS]
-    delay2 = jax.random.randint(
-        knet[2], (g, g, ns), kcfg.pull_delay_min, kcfg.pull_delay_max + 1,
-        dtype=I32,
-    )
-    lost2 = jax.random.bernoulli(kp[2], kcfg.pull_loss, (g, g, ns))
+    delay2, lost2 = _net_pair(knet[2], (g, g, ns))
     send_req = do_pull[:, None, :] & tgt_oh & ~lost2
     pull_req_t = jnp.where(send_req, t + delay2, pull_req_t)
     pull_req_cfg = jnp.where(
@@ -907,11 +922,7 @@ def shardkv_step(
         (l_phase == FROZEN) & (freeze_cfg > 0) & gc_draw & lead_any[:, None]
     )
     gtgt_oh = gain_owner[:, None, :] == my_gv[None, :, None]  # [holder, dst?, NS]
-    gdelay2 = jax.random.randint(
-        knet[5], (g, g, ns), kcfg.pull_delay_min, kcfg.pull_delay_max + 1,
-        dtype=I32,
-    )
-    glost2 = jax.random.bernoulli(knet[6], kcfg.pull_loss, (g, g, ns))
+    gdelay2, glost2 = _net_pair(knet[3], (g, g, ns))
     # keep-oldest: a poll in flight is not re-stamped by the next draw
     # (otherwise p_ack ~ 1/delay re-sends could starve delivery forever)
     send_gcq = (

@@ -151,9 +151,12 @@ def test_shardkv_gc_completes_under_storm():
     assert (rep.final_cfg >= kcfg.n_configs - 2).all(), (
         f"schedule stalled: final configs {np.sort(rep.final_cfg)}"
     )
-    assert (rep.deletes == rep.installs).all(), "GC must keep up with installs"
-    # a handful of frozen copies may legitimately serve migrations still in
-    # flight at the cutoff; a LEAK would accumulate dozens over 16 configs
+    # GC keeps up with installs: a handful of migrations may be mid-flight
+    # at the cutoff (no quiesce tail), but a LEAK accumulates dozens
+    lag = rep.installs - rep.deletes
+    assert (lag >= 0).all() and (lag <= kcfg.n_shards).all(), (
+        f"GC lag per deployment: {lag}"
+    )
     assert rep.frozen_left.sum() <= kcfg.n_shards, (
         f"frozen copies leaked: {rep.frozen_left.sum()}"
     )
