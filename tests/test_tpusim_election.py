@@ -96,3 +96,40 @@ def test_oracle_catches_broken_quorum():
     assert (bits & VIOLATION_DUAL_LEADER).any()
     # and the failure is pinpointed to a tick for replay
     assert (rep.first_violation_tick[rep.violating_clusters()] >= 0).all()
+
+
+def test_raft_timing_requirement_faithful():
+    """The simulator reproduces the paper's §5.6 timing requirement
+    (broadcastTime << electionTimeout << MTBF) and the textbook case for
+    RANDOMIZED timeouts — both as liveness, never safety, properties:
+      * zero timeout randomness => perfectly symmetric split votes forever
+        (in lockstep nothing ever breaks the tie: every node re-times-out
+        on the same tick, votes for itself, repeats);
+      * delays comparable to the election timeout => vote requests arrive
+        around the voters' own timeouts and terms churn without progress;
+      * restore the timing requirement => every cluster elects and commits.
+    Safety (zero violations) holds in all three regimes."""
+    degenerate = SimConfig(
+        n_nodes=5, p_client_cmd=0.2, election_timeout_min=16,
+        election_timeout_max=16, loss_prob=0.1,
+    )
+    rep = fuzz(degenerate, seed=4242, n_clusters=32, n_ticks=768)
+    assert rep.n_violating == 0
+    assert (rep.first_leader_tick < 0).all(), (
+        "zero-randomness timeouts must livelock symmetric elections"
+    )
+
+    slow = SimConfig(
+        n_nodes=5, p_client_cmd=0.2, delay_min=8, delay_max=20,
+        election_timeout_min=15, election_timeout_max=30,
+    )
+    rep = fuzz(slow, seed=4242, n_clusters=32, n_ticks=1024)
+    assert rep.n_violating == 0
+    assert (rep.committed > 0).mean() < 0.5, (
+        "broadcastTime ~ electionTimeout must (mostly) destroy liveness"
+    )
+
+    healthy = slow.replace(delay_min=1, delay_max=3)
+    rep = fuzz(healthy, seed=4242, n_clusters=32, n_ticks=1024)
+    assert rep.n_violating == 0
+    assert (rep.committed > 0).all(), "timing requirement restored => live"
