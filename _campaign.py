@@ -32,17 +32,14 @@ def check(name, ok, detail=""):
     print(f"[{time.time()-t0:6.0f}s] {'OK ' if ok else 'FAIL'} {name} {detail}", flush=True)
     if not ok: fails.append(name)
 
-# 1. knob grid in one program: loss x crash x repartition
+# 1. knob grid in one program: loss x crash x repartition (grid shared with
+# _soak.py so the campaign and the on-chip soak sweep the same space)
+from _soak import GRID_COMBOS as combos, grid_knobs
+
 base = SimConfig(n_nodes=5, p_client_cmd=0.2, p_restart=0.2, max_dead=2, p_heal=0.05)
-combos = [(l, c, r) for l in (0.0, 0.1, 0.3, 0.5) for c in (0.0, 0.02) for r in (0.0, 0.05)]
 per = 24
 n = len(combos) * per
-kn = base.knobs()
-loss = jnp.repeat(jnp.asarray([x[0] for x in combos], jnp.float32), per)
-crash = jnp.repeat(jnp.asarray([x[1] for x in combos], jnp.float32), per)
-rep_p = jnp.repeat(jnp.asarray([x[2] for x in combos], jnp.float32), per)
-kn = kn._replace(loss_prob=loss, p_crash=crash, p_repartition=rep_p)
-r = report(make_sweep_fn(base, kn, n, 1024)(77))
+r = report(make_sweep_fn(base, grid_knobs(base, n), n, 1024)(77))
 check("grid 16-combo sweep", r.n_violating == 0, f"viol={r.n_violating}")
 for i, (l, c, rp) in enumerate(combos):
     com = r.committed[i*per:(i+1)*per]

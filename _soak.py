@@ -1,0 +1,211 @@
+"""On-chip safety soak: the north-star claim, measured, at 10x scale.
+
+BASELINE.json sets the bar at ">=100k 5-node cluster-steps/s/chip with zero
+safety violations per 1e9 cluster-steps". This tool runs >= 1e10 cluster-steps
+on the attached accelerator — the flagship fuzz config, a harsher fault storm,
+the 16-combo knob grid, and the kv / shardkv service stacks — and records the
+evidence as ``SOAK_r{N}.json``: total steps, violations (must be 0), liveness
+counters, and throughput per region.
+
+Each region is ONE compiled program re-invoked with a fresh seed per rep
+(engine.make_fuzz_fn's seed is a runtime argument), so the soak covers
+``reps x n_clusters`` distinct (seed, schedule) universes at full device
+throughput. Any violation reports (seed, cluster_id) for exact replay via
+``engine.replay_cluster`` / the differential bridge (bridge.py).
+
+Usage:
+    python _soak.py                # full soak (~15 min on TPU v5e)
+    python _soak.py 0.01          # scaled: 1% of the full step budget
+    SOAK_OUT=SOAK_r03.json python _soak.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.engine import make_fuzz_fn, make_sweep_fn, report
+from madraft_tpu.tpusim.kv import KvConfig, make_kv_fuzz_fn
+from madraft_tpu.tpusim.shardkv import (
+    ShardKvConfig,
+    make_shardkv_fuzz_fn,
+    shardkv_report,
+)
+
+# set by main(); module-level default keeps `import _soak` (e.g. from
+# _campaign.py, for the shared grid) argument-free
+SCALE = 1.0
+
+
+def flagship() -> SimConfig:
+    return SimConfig(
+        n_nodes=5, p_client_cmd=0.2, loss_prob=0.1, p_crash=0.01,
+        p_restart=0.2, max_dead=2, p_repartition=0.02, p_heal=0.05,
+    )
+
+
+def storm() -> SimConfig:
+    # every fault class at once, including the round-3 targeted cuts
+    return SimConfig(
+        n_nodes=5, p_client_cmd=0.3, loss_prob=0.3, p_crash=0.02,
+        p_restart=0.2, max_dead=2, p_repartition=0.05, p_heal=0.08,
+        p_leader_part=0.01, p_asym_cut=0.03,
+    )
+
+
+# The 16-combo loss x crash x repartition grid shared with _campaign.py
+# (single source so the soak and the campaign always sweep the same space).
+GRID_COMBOS = [
+    (l, c, r)
+    for l in (0.0, 0.1, 0.3, 0.5)
+    for c in (0.0, 0.02)
+    for r in (0.0, 0.05)
+]
+
+
+def grid_knobs(cfg: SimConfig, n: int):
+    """Per-cluster knobs tiling GRID_COMBOS across a batch of n clusters."""
+    combos = GRID_COMBOS
+    per = n // len(combos)
+    reps = [per] * len(combos)
+    reps[-1] += n - per * len(combos)
+    loss = jnp.repeat(
+        jnp.asarray([x[0] for x in combos], jnp.float32),
+        jnp.asarray(reps), total_repeat_length=n,
+    )
+    crash = jnp.repeat(
+        jnp.asarray([x[1] for x in combos], jnp.float32),
+        jnp.asarray(reps), total_repeat_length=n,
+    )
+    rep_p = jnp.repeat(
+        jnp.asarray([x[2] for x in combos], jnp.float32),
+        jnp.asarray(reps), total_repeat_length=n,
+    )
+    return cfg.knobs()._replace(loss_prob=loss, p_crash=crash, p_repartition=rep_p)
+
+
+def drive(name, fn, steps_per_rep, target_steps, stats, seed0):
+    """Re-invoke fn(seed) until target_steps; return the region row.
+
+    ``stats(final) -> (violation_array, live_count)`` is called once per rep.
+    One warm-up rep (an extra seed, not counted) runs before the clock starts
+    so XLA compilation never pollutes the recorded steps_per_sec.
+    """
+    reps = max(1, int(round(target_steps / steps_per_rep)))
+    stats(fn(seed0 - 1))  # warm-up: compile + first run, excluded from timing
+    t0 = time.perf_counter()
+    viol = 0
+    live = 0
+    bad = []
+    for r in range(reps):
+        final = fn(seed0 + r)
+        v, l = stats(final)
+        viol += int((v != 0).sum())
+        if (v != 0).any():
+            bad.append({"seed": seed0 + r, "clusters": np.nonzero(v != 0)[0][:8].tolist()})
+        live += int(l)
+    wall = time.perf_counter() - t0
+    row = {
+        "region": name,
+        "reps": reps,
+        "cluster_steps": reps * steps_per_rep,
+        "wall_s": round(wall, 1),
+        "steps_per_sec": round(reps * steps_per_rep / wall, 1),
+        "violating_clusters": viol,
+        "live_clusters": live,
+    }
+    if bad:
+        row["violations"] = bad[:16]
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main() -> None:
+    global SCALE
+    if len(sys.argv) > 1:
+        SCALE = float(sys.argv[1])
+    dev = str(jax.devices()[0])
+    t_start = time.time()
+    rows = []
+
+    def raft_stats(f):
+        return (np.asarray(f.violations),
+                int((np.asarray(f.shadow_len) > 0).sum()))
+
+    # --- raft flagship: ~6e9 steps -----------------------------------------
+    nc, nt = 4096, 2048
+    cfg = flagship()
+    fn = make_fuzz_fn(cfg, nc, nt)
+    rows.append(drive(
+        "raft_flagship", fn, nc * nt, 6e9 * SCALE, raft_stats, seed0=1000,
+    ))
+
+    # --- raft storm: ~2e9 steps --------------------------------------------
+    fn = make_fuzz_fn(storm(), nc, nt)
+    rows.append(drive(
+        "raft_storm", fn, nc * nt, 2e9 * SCALE, raft_stats, seed0=2000,
+    ))
+
+    # --- knob grid (heterogeneous knobs, one program): ~1e9 steps ----------
+    fn = make_sweep_fn(flagship(), grid_knobs(flagship(), nc), nc, nt)
+    rows.append(drive(
+        "raft_grid16", fn, nc * nt, 1e9 * SCALE, raft_stats, seed0=3000,
+    ))
+
+    # --- kv service stack: ~5e8 steps --------------------------------------
+    kcfg = flagship().replace(
+        p_client_cmd=0.0, compact_at_commit=False, compact_every=16
+    )
+    nck, ntk = 1024, 1024
+    fn = make_kv_fuzz_fn(kcfg, KvConfig(p_get=0.3), nck, ntk)
+    rows.append(drive(
+        "kv_fuzz", fn, nck * ntk, 5e8 * SCALE,
+        lambda f: (np.asarray(f.raft.violations),
+                   int((np.asarray(f.clerk_acked).sum(axis=-1) > 0).sum())),
+        seed0=4000,
+    ))
+
+    # --- shardkv service stack: ~2e8 group-cluster steps -------------------
+    scfg = SimConfig(
+        n_nodes=3, p_client_cmd=0.0, compact_at_commit=False, log_cap=64,
+        compact_every=16, loss_prob=0.05,
+    )
+    skcfg = ShardKvConfig()
+    ncs, nts = 256, 512
+    fn = make_shardkv_fuzz_fn(scfg, skcfg, ncs, nts)
+
+    def skv_stats(f):
+        r = shardkv_report(f)  # service-level AND per-group raft violations
+        return r.violations | r.raft_violations, int(r.installs.sum())
+
+    rows.append(drive(
+        "shardkv_fuzz", fn, ncs * nts * skcfg.n_groups, 2e8 * SCALE,
+        skv_stats, seed0=5000,
+    ))
+
+    total = sum(r["cluster_steps"] for r in rows)
+    viol = sum(r["violating_clusters"] for r in rows)
+    out = {
+        "metric": "soak_cluster_steps_zero_violations",
+        "total_cluster_steps": total,
+        "violating_clusters": viol,
+        "wall_s": round(time.time() - t_start, 1),
+        "device": dev,
+        "scale": SCALE,
+        "regions": rows,
+    }
+    path = os.environ.get("SOAK_OUT")
+    if path:
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+    sys.exit(1 if viol else 0)
+
+
+if __name__ == "__main__":
+    main()

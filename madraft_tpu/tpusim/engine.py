@@ -47,35 +47,56 @@ def _cluster_keys(seed, n_clusters: int) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=None)
-def _fuzz_program(static_cfg: SimConfig, n_clusters: int, mesh: Optional[Mesh]):
-    """One compiled program per (static shape, batch, mesh).
+def _fuzz_program(
+    static_cfg: SimConfig,
+    n_clusters: int,
+    mesh: Optional[Mesh],
+    per_cluster_knobs: bool = False,
+):
+    """One compiled program per (static shape, batch, mesh, knob layout).
 
     Everything else — probabilities, timeouts, quorum override, tick count —
-    is a runtime argument: the dynamic knobs ride in as a per-cluster `Knobs`
-    pytree and the tick count as a `fori_loop` bound. Two configs differing
-    only in dynamic knobs (or tick counts) share this program, which is what
-    keeps a cold test-suite run compile-light and lets one program sweep a
-    grid of fault intensities across the cluster batch.
+    is a runtime argument: the dynamic knobs ride in as a `Knobs` pytree and
+    the tick count as a `fori_loop` bound. Two configs differing only in
+    dynamic knobs (or tick counts) share this program, which is what keeps a
+    cold test-suite run compile-light.
+
+    ``per_cluster_knobs`` picks the knob layout. UNIFORM (scalars, vmap
+    in_axes=None) is the default and the fast path: runtime scalar knobs
+    measured WITHIN NOISE of compile-time-baked constants (19.6 vs 20.9
+    M steps/s at the 4096-cluster flagship). Per-cluster knob ARRAYS — one
+    value per cluster, what make_sweep_fn needs to sweep a fault grid in one
+    program — measured a 2.4x cliff (8.1 M): vmapping the knob axis pushes a
+    per-cluster scalar into every elementwise op, defeating broadcast
+    vectorization. So sweeps alone pay it; plain fuzzing never does.
     """
     constraint = None
     if mesh is not None:
         constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
+    kn_ax = 0 if per_cluster_knobs else None
 
     def run(seed, kn, n_ticks) -> ClusterState:
         keys = _cluster_keys(seed, n_clusters)
-        states = jax.vmap(functools.partial(init_cluster, static_cfg))(keys, kn)
+        states = jax.vmap(
+            functools.partial(init_cluster, static_cfg), in_axes=(0, kn_ax)
+        )(keys, kn)
         if constraint is not None:
             states = jax.lax.with_sharding_constraint(
                 states, jax.tree.map(lambda _: constraint, states)
             )
             keys2 = jax.lax.with_sharding_constraint(keys, constraint)
+            if per_cluster_knobs:
+                kn = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, constraint), kn
+                )
         else:
             keys2 = keys
 
         def body(_, carry):
-            return jax.vmap(functools.partial(step_cluster, static_cfg))(
-                carry, keys2, kn
-            )
+            return jax.vmap(
+                functools.partial(step_cluster, static_cfg),
+                in_axes=(0, 0, kn_ax),
+            )(carry, keys2, kn)
 
         return jax.lax.fori_loop(0, n_ticks, body, states)
 
@@ -94,7 +115,7 @@ def make_fuzz_fn(
     first axis (pure data parallelism; per-step work stays chip-local).
     """
     prog = _fuzz_program(cfg.static_key(), n_clusters, mesh)
-    kn = cfg.knobs().broadcast(n_clusters)
+    kn = cfg.knobs()  # uniform runtime scalars — the fast knob layout
     ticks = jnp.asarray(n_ticks, jnp.int32)
     # coerce exactly like fuzz()/replay_cluster(): with x64 enabled a
     # negative or >= 2^32 Python-int seed would otherwise promote to int64
@@ -137,8 +158,8 @@ def make_sweep_fn(
     fault-parameter sweep (e.g. loss x crash-rate grid) in ONE compiled
     program, something the reference's compile-time test matrix cannot do."""
     _validate_knobs(knobs)
-    prog = _fuzz_program(cfg.static_key(), n_clusters, mesh)
-    kn = jax.tree.map(lambda x: jnp.broadcast_to(x, (n_clusters,)), knobs)
+    prog = _fuzz_program(cfg.static_key(), n_clusters, mesh, per_cluster_knobs=True)
+    kn = knobs.broadcast(n_clusters)
     ticks = jnp.asarray(n_ticks, jnp.int32)
     return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, ticks)
 

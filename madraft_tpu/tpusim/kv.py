@@ -581,7 +581,9 @@ def _kv_program(
     mesh: Optional[Mesh],
 ):
     """One compiled program per static shape; probabilities, bug modes, and
-    the tick count are runtime arguments (see engine._fuzz_program)."""
+    the tick count are runtime arguments. Knobs are UNIFORM runtime scalars
+    (vmap in_axes=None) — the fast knob layout; per-cluster knob arrays
+    measured a 2.4x cliff (see engine._fuzz_program)."""
     constraint = None
     if mesh is not None:
         constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
@@ -592,7 +594,8 @@ def _kv_program(
             jnp.arange(n_clusters)
         )
         states = jax.vmap(
-            functools.partial(init_kv_cluster, static_cfg, static_kcfg)
+            functools.partial(init_kv_cluster, static_cfg, static_kcfg),
+            in_axes=(0, None),
         )(keys, kn)
         if constraint is not None:
             states = jax.lax.with_sharding_constraint(
@@ -602,7 +605,8 @@ def _kv_program(
 
         def body(_, carry):
             return jax.vmap(
-                functools.partial(kv_step, static_cfg, static_kcfg)
+                functools.partial(kv_step, static_cfg, static_kcfg),
+                in_axes=(0, 0, None, None),
             )(carry, keys, kn, kkn)
 
         return jax.lax.fori_loop(0, n_ticks, body, states)
@@ -620,10 +624,8 @@ def make_kv_fuzz_fn(
     """Build fn(seed) -> final batched KvState (see engine.make_fuzz_fn)."""
     _check_kv_cfg(cfg)
     prog = _kv_program(cfg.static_key(), kcfg.static_key(), n_clusters, mesh)
-    kn = cfg.knobs().broadcast(n_clusters)
-    kkn = jax.tree.map(
-        lambda x: jnp.broadcast_to(x, (n_clusters,)), kcfg.knobs()
-    )
+    kn = cfg.knobs()    # uniform runtime scalars — the fast knob layout
+    kkn = kcfg.knobs()
     ticks = jnp.asarray(n_ticks, jnp.int32)
     # uint32 coercion: keep the (seed, cluster_id) replay contract under x64
     return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, kkn, ticks)

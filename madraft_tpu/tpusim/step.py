@@ -82,9 +82,12 @@ class _DrawBlock:
     fixed order — same determinism contract (a pure function of the key),
     one PRNG invocation.
 
-    randint uses modulo (negligible bias for the tiny spans here; exact when
-    the span is a power of two, which the default timeout span is — swept
-    configs may use any span).
+    randint uses a fixed-point multiply-shift (floor(u01 * span)) instead of
+    `draw % span`: with TRACED spans (dynamic knobs are per-cluster runtime
+    arrays) an integer modulo lowers to a division sequence, which measured
+    ~2.7x on the whole tick; the multiply-shift is one VPU multiply. Bias is
+    <= span/2^24 (vs span/2^32 for modulo) — negligible for the tick-scale
+    spans here, and the uniformity class is unchanged.
     """
 
     def __init__(self, key: jax.Array, total: int):
@@ -113,9 +116,11 @@ class _DrawBlock:
         return self._u01(self._take(shape)) < p
 
     def randint(self, lo, hi, shape):  # [lo, hi); bounds may be traced i32
-        span = (jnp.asarray(hi, I32) - jnp.asarray(lo, I32)).astype(jnp.uint32)
+        span = (jnp.asarray(hi, I32) - jnp.asarray(lo, I32)).astype(jnp.float32)
+        # floor(u01 * span): u01 < 1.0 exactly (see _u01), so the result is
+        # always in [0, span). No integer division anywhere.
         return (jnp.asarray(lo, I32)
-                + (self._take(shape) % span).astype(I32))
+                + jnp.floor(self._u01(self._take(shape)) * span).astype(I32))
 
     def uniform(self, shape):
         return self._u01(self._take(shape))
@@ -136,17 +141,19 @@ def _timeout_draw(kn, blk: "_DrawBlock", shape) -> jax.Array:
 def _net_draws(kn, blk: "_DrawBlock", shape):
     """(delay, lost) draws for a batch of sends, packed into ONE u32 per
     send: bits 8..31 decide loss (via _u01 — exact, < 1.0), bits 0..7
-    decide the delay via modulo (bias <= span/256 for the tick-scale spans
-    here; spans wider than 256 are clamped so every value stays drawable
-    rather than silently truncating the regime). Disjoint bit ranges of one
-    threefry word are independent draws."""
+    decide the delay via multiply-shift ((w & 0xFF) * span) >> 8 — the exact
+    same bias class (<= span/256) as the former modulo, with no integer
+    division (traced-span modulo was the measured dynamic-knob cliff; see
+    _DrawBlock). Spans wider than 256 are clamped so every value stays
+    drawable rather than silently truncating the regime. Disjoint bit ranges
+    of one threefry word are independent draws."""
     w = blk._take(shape)
     lost = blk._u01(w) < kn.loss_prob
     span = jnp.clip(
         jnp.asarray(kn.delay_max, I32) + 1 - jnp.asarray(kn.delay_min, I32),
         1, 256,
     ).astype(jnp.uint32)
-    delay = jnp.asarray(kn.delay_min, I32) + ((w & 0xFF) % span).astype(I32)
+    delay = jnp.asarray(kn.delay_min, I32) + (((w & 0xFF) * span) >> 8).astype(I32)
     return delay, lost
 
 

@@ -10,7 +10,10 @@ import numpy as np
 import pytest
 
 from madraft_tpu.tpusim import SimConfig, fuzz
-from madraft_tpu.tpusim.config import VIOLATION_DUAL_LEADER
+from madraft_tpu.tpusim.config import (
+    VIOLATION_DUAL_LEADER,
+    VIOLATION_LOG_MATCHING,
+)
 from madraft_tpu.tpusim.engine import make_fuzz_fn, report
 
 RELIABLE = SimConfig(n_nodes=3, p_client_cmd=0.0)
@@ -96,6 +99,25 @@ def test_oracle_catches_broken_quorum():
     assert (bits & VIOLATION_DUAL_LEADER).any()
     # and the failure is pinpointed to a tick for replay
     assert (rep.first_violation_tick[rep.violating_clusters()] >= 0).all()
+
+
+def test_oracle_catches_log_divergence():
+    # Validate the LOG-MATCHING oracle (the pairwise same-(index,term) =>
+    # identical-prefix reduction in step.py) by the same broken-quorum bug,
+    # now with a client workload: two same-term leaders each accept different
+    # commands at the same index, so some pair of logs shares (index, term)
+    # with diverging values — exactly the Log Matching Property violation
+    # the checker must flag (the batched analogue of push_and_check,
+    # /root/reference/src/raft/tester.rs:379-397).
+    cfg = SimConfig(
+        n_nodes=5, majority_override=2, p_client_cmd=0.3,
+        p_repartition=0.05, p_heal=0.02,
+    )
+    rep = fuzz(cfg, seed=5, n_clusters=64, n_ticks=400)
+    bits = rep.violations[rep.violating_clusters()]
+    assert (bits & VIOLATION_LOG_MATCHING).any(), (
+        "log-matching oracle failed to catch same-term divergence"
+    )
 
 
 def test_raft_timing_requirement_faithful():

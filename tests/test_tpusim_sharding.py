@@ -27,6 +27,24 @@ def test_sharded_run_matches_unsharded():
     assert rep_shard.n_violating == 0
 
 
+def test_sharded_sweep_matches_unsharded():
+    # The per-cluster-knob layout (make_sweep_fn) has its own mesh branch in
+    # _fuzz_program — the knob pytree itself is sharding-constrained along
+    # the cluster axis. A heterogeneous loss sweep must produce identical
+    # reports sharded and unsharded.
+    from madraft_tpu.tpusim.engine import make_sweep_fn, report
+
+    cfg = SimConfig(n_nodes=3, p_client_cmd=0.2)
+    kn = cfg.knobs()._replace(
+        loss_prob=jnp.repeat(jnp.asarray([0.0, 0.3], jnp.float32), 8)
+    )
+    rep_local = report(make_sweep_fn(cfg, kn, 16, 200)(9))
+    rep_shard = report(make_sweep_fn(cfg, kn, 16, 200, mesh=_mesh())(9))
+    np.testing.assert_array_equal(rep_local.msg_count, rep_shard.msg_count)
+    np.testing.assert_array_equal(rep_local.committed, rep_shard.committed)
+    assert rep_shard.n_violating == 0
+
+
 def test_sharded_state_placement():
     mesh = _mesh()
     fn = make_fuzz_fn(SimConfig(n_nodes=3), n_clusters=16, n_ticks=20, mesh=mesh)
