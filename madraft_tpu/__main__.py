@@ -11,7 +11,11 @@ Every command prints one JSON line (machine-readable; violations are data).
 A violating cluster reported by `fuzz` is reproduced exactly by `replay`
 with the same (seed, cluster) — the MADSIM_TEST_SEED replay contract — and
 `bridge` closes the loop by re-running its fault schedule on the C++
-runtime via the in-process bindings (madraft_tpu.simcore).
+runtime via the in-process bindings (madraft_tpu.simcore). The fuzz
+commands accept `--check-deterministic` (or the env var
+MADTPU_TEST_CHECK_DETERMINISTIC, the C++ runner's spelling) to double-run
+and demand a bit-identical report — the MADSIM_TEST_CHECK_DETERMINISTIC
+analogue.
 """
 
 from __future__ import annotations
@@ -39,6 +43,37 @@ def _sim_config(args):
     return cfg
 
 
+def _reports_equal(a, b) -> bool:
+    import numpy as np
+
+    return all(
+        np.array_equal(getattr(a, f), getattr(b, f)) for f in a._fields
+    )
+
+
+def _finish_fuzz(args, run):
+    """Run a fuzz closure, optionally double-run for the determinism check,
+    print the JSON report, and return the exit code.
+
+    The check is the reference's MADSIM_TEST_CHECK_DETERMINISTIC contract on
+    the batched backend (/root/reference/README.md:81-87): re-run the
+    identical program and demand a bit-identical report. Enabled by
+    --check-deterministic or the env var MADTPU_TEST_CHECK_DETERMINISTIC —
+    which shares the C++ runner's semantics: unset, empty, or "0" disables."""
+    import os
+
+    rep = run()
+    env = os.environ.get("MADTPU_TEST_CHECK_DETERMINISTIC", "0")
+    extra = {}
+    det_failed = False
+    if args.check_deterministic or env not in ("", "0"):
+        same = _reports_equal(rep, run())
+        extra = {"deterministic": bool(same)}
+        det_failed = not same
+    _report_json(rep, {"seed": args.seed, **extra})
+    return 1 if (rep.n_violating or det_failed) else 0
+
+
 def _report_json(rep, extra=None):
     out = {
         "violating": int(rep.n_violating),
@@ -56,10 +91,11 @@ def _report_json(rep, extra=None):
 def cmd_fuzz(args):
     from madraft_tpu.tpusim.engine import fuzz
 
-    rep = fuzz(_sim_config(args), seed=args.seed, n_clusters=args.clusters,
-               n_ticks=args.ticks)
-    _report_json(rep, {"seed": args.seed})
-    return 1 if rep.n_violating else 0
+    def run():
+        return fuzz(_sim_config(args), seed=args.seed,
+                    n_clusters=args.clusters, n_ticks=args.ticks)
+
+    return _finish_fuzz(args, run)
 
 
 def cmd_kv_fuzz(args):
@@ -68,10 +104,12 @@ def cmd_kv_fuzz(args):
     cfg = _sim_config(args).replace(
         p_client_cmd=0.0, compact_at_commit=False, compact_every=16
     )
-    rep = kv_fuzz(cfg, KvConfig(p_get=args.p_get), seed=args.seed,
-                  n_clusters=args.clusters, n_ticks=args.ticks)
-    _report_json(rep, {"seed": args.seed})
-    return 1 if rep.n_violating else 0
+
+    def run():
+        return kv_fuzz(cfg, KvConfig(p_get=args.p_get), seed=args.seed,
+                       n_clusters=args.clusters, n_ticks=args.ticks)
+
+    return _finish_fuzz(args, run)
 
 
 def cmd_shardkv_fuzz(args):
@@ -85,10 +123,13 @@ def cmd_shardkv_fuzz(args):
         p_crash=0.01 if args.storm else 0.0,
         p_restart=0.2, max_dead=1 if args.storm else 0,
     )
-    rep = shardkv_fuzz(cfg, ShardKvConfig(p_get=args.p_get), seed=args.seed,
-                       n_clusters=args.clusters, n_ticks=args.ticks)
-    _report_json(rep, {"seed": args.seed})
-    return 1 if rep.n_violating else 0
+
+    def run():
+        return shardkv_fuzz(cfg, ShardKvConfig(p_get=args.p_get),
+                            seed=args.seed, n_clusters=args.clusters,
+                            n_ticks=args.ticks)
+
+    return _finish_fuzz(args, run)
 
 
 def cmd_replay(args):
@@ -142,17 +183,25 @@ def main(argv=None) -> int:
         sp.add_argument("--majority-override", type=int, default=0,
                         help="deliberately broken quorum (oracle demo)")
 
+    def fuzz_common(sp, clusters):
+        common(sp, clusters)
+        sp.add_argument("--check-deterministic", action="store_true",
+                        help="run twice, demand a bit-identical report "
+                             "(MADSIM_TEST_CHECK_DETERMINISTIC analogue; "
+                             "also enabled by the env var "
+                             "MADTPU_TEST_CHECK_DETERMINISTIC)")
+
     sp = sub.add_parser("fuzz", help="raw-raft batched fuzz")
-    common(sp, 4096)
+    fuzz_common(sp, 4096)
     sp.set_defaults(fn=cmd_fuzz)
 
     sp = sub.add_parser("kv-fuzz", help="KV service fuzz (Lab 3)")
-    common(sp, 512)
+    fuzz_common(sp, 512)
     sp.add_argument("--p-get", type=float, default=0.3)
     sp.set_defaults(fn=cmd_kv_fuzz)
 
     sp = sub.add_parser("shardkv-fuzz", help="multi-group sharded KV (Lab 4B)")
-    common(sp, 64)
+    fuzz_common(sp, 64)
     sp.add_argument("--p-get", type=float, default=0.3)
     sp.set_defaults(fn=cmd_shardkv_fuzz)
 

@@ -40,6 +40,19 @@ def test_cli_fuzz_replay_bridge_loop():
     assert out["classes_match"], out
 
 
+def test_cli_check_deterministic():
+    # The MADSIM_TEST_CHECK_DETERMINISTIC analogue on the batched backend:
+    # the flag re-runs the identical program and demands a bit-identical
+    # report (/root/reference/README.md:81-87; the C++ runner's env-var twin
+    # is covered by the cpp suite wrapper).
+    rc, out = run(["fuzz", "--clusters", "32", "--ticks", "128", "--storm",
+                   "--check-deterministic"])
+    assert rc == 0 and out["deterministic"] is True, out
+    rc, out = run(["kv-fuzz", "--clusters", "16", "--ticks", "128",
+                   "--check-deterministic"])
+    assert rc == 0 and out["deterministic"] is True, out
+
+
 def test_cli_service_layers():
     rc, out = run(["kv-fuzz", "--clusters", "32", "--ticks", "256", "--storm"])
     assert rc == 0 and out["violating"] == 0 and out["acked_ops_mean"] > 0
