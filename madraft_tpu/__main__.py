@@ -43,6 +43,26 @@ def _sim_config(args):
     return cfg
 
 
+def _mesh(args):
+    """--mesh: shard the cluster batch over every attached device (the
+    workload's scaling axis — pure data parallelism, no cross-chip
+    collectives on the hot path; SURVEY.md §5). Multi-host deployments
+    initialize jax.distributed before invoking the CLI and get the global
+    device set the same way."""
+    if not getattr(args, "mesh", False):
+        return None
+    import jax
+    import numpy as np
+
+    devs = np.array(jax.devices())
+    if args.clusters % len(devs):
+        raise SystemExit(
+            f"--clusters {args.clusters} must divide evenly over "
+            f"{len(devs)} devices"
+        )
+    return jax.sharding.Mesh(devs, ("clusters",))
+
+
 def _reports_equal(a, b) -> bool:
     import numpy as np
 
@@ -91,9 +111,11 @@ def _report_json(rep, extra=None):
 def cmd_fuzz(args):
     from madraft_tpu.tpusim.engine import fuzz
 
+    mesh = _mesh(args)
+
     def run():
         return fuzz(_sim_config(args), seed=args.seed,
-                    n_clusters=args.clusters, n_ticks=args.ticks)
+                    n_clusters=args.clusters, n_ticks=args.ticks, mesh=mesh)
 
     return _finish_fuzz(args, run)
 
@@ -105,9 +127,11 @@ def cmd_kv_fuzz(args):
         p_client_cmd=0.0, compact_at_commit=False, compact_every=16
     )
 
+    mesh = _mesh(args)
+
     def run():
         return kv_fuzz(cfg, KvConfig(p_get=args.p_get), seed=args.seed,
-                       n_clusters=args.clusters, n_ticks=args.ticks)
+                       n_clusters=args.clusters, n_ticks=args.ticks, mesh=mesh)
 
     return _finish_fuzz(args, run)
 
@@ -124,10 +148,12 @@ def cmd_shardkv_fuzz(args):
         p_restart=0.2, max_dead=1 if args.storm else 0,
     )
 
+    mesh = _mesh(args)
+
     def run():
         return shardkv_fuzz(cfg, ShardKvConfig(p_get=args.p_get),
                             seed=args.seed, n_clusters=args.clusters,
-                            n_ticks=args.ticks)
+                            n_ticks=args.ticks, mesh=mesh)
 
     return _finish_fuzz(args, run)
 
@@ -185,6 +211,9 @@ def main(argv=None) -> int:
 
     def fuzz_common(sp, clusters):
         common(sp, clusters)
+        sp.add_argument("--mesh", action="store_true",
+                        help="shard the cluster batch over ALL attached "
+                             "devices (jax.sharding.Mesh data parallelism)")
         sp.add_argument("--check-deterministic", action="store_true",
                         help="run twice, demand a bit-identical report "
                              "(MADSIM_TEST_CHECK_DETERMINISTIC analogue; "

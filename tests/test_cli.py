@@ -53,6 +53,21 @@ def test_cli_check_deterministic():
     assert rc == 0 and out["deterministic"] is True, out
 
 
+def test_cli_mesh_flag():
+    # --mesh shards the cluster batch over all attached devices (the 8-device
+    # virtual CPU mesh here) and must not change any report field; a batch
+    # that does not divide over the devices is rejected eagerly.
+    rc, out = run(["fuzz", "--clusters", "32", "--ticks", "128", "--storm"])
+    rc_m, out_m = run(["fuzz", "--clusters", "32", "--ticks", "128", "--storm",
+                       "--mesh"])
+    assert rc == rc_m == 0 and out == out_m, (out, out_m)
+    import jax
+
+    if len(jax.devices()) > 1:  # on one device every batch divides evenly
+        with pytest.raises(SystemExit):
+            run(["fuzz", "--clusters", "33", "--ticks", "16", "--mesh"])
+
+
 def test_cli_service_layers():
     rc, out = run(["kv-fuzz", "--clusters", "32", "--ticks", "256", "--storm"])
     assert rc == 0 and out["violating"] == 0 and out["acked_ops_mean"] > 0
