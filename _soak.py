@@ -14,8 +14,9 @@ throughput. Any violation reports (seed, cluster_id) for exact replay via
 ``engine.replay_cluster`` / the differential bridge (bridge.py).
 
 Usage:
-    python _soak.py                # full soak (~15 min on TPU v5e)
-    python _soak.py 0.01          # scaled: 1% of the full step budget
+    python _soak.py                   # full soak (~20 min on TPU v5e)
+    python _soak.py 0.01              # scaled: 1% of the full step budget
+    python _soak.py 1.0 500000        # fresh seed base: all-new universes
     SOAK_OUT=SOAK_r03.json python _soak.py
 """
 
@@ -37,9 +38,10 @@ from madraft_tpu.tpusim.shardkv import (
     shardkv_report,
 )
 
-# set by main(); module-level default keeps `import _soak` (e.g. from
+# set by main(); module-level defaults keep `import _soak` (e.g. from
 # _campaign.py, for the shared grid) argument-free
 SCALE = 1.0
+SEED_BASE = 0  # added to every region's seed0: re-runs cover fresh universes
 
 
 def flagship() -> SimConfig:
@@ -96,6 +98,7 @@ def drive(name, fn, steps_per_rep, target_steps, stats, seed0):
     One warm-up rep (an extra seed, not counted) runs before the clock starts
     so XLA compilation never pollutes the recorded steps_per_sec.
     """
+    seed0 += SEED_BASE
     reps = max(1, int(round(target_steps / steps_per_rep)))
     stats(fn(seed0 - 1))  # warm-up: compile + first run, excluded from timing
     t0 = time.perf_counter()
@@ -126,9 +129,11 @@ def drive(name, fn, steps_per_rep, target_steps, stats, seed0):
 
 
 def main() -> None:
-    global SCALE
+    global SCALE, SEED_BASE
     if len(sys.argv) > 1:
         SCALE = float(sys.argv[1])
+    if len(sys.argv) > 2:
+        SEED_BASE = int(sys.argv[2])
     dev = str(jax.devices()[0])
     t_start = time.time()
     rows = []
@@ -149,6 +154,17 @@ def main() -> None:
     fn = make_fuzz_fn(storm(), nc, nt)
     rows.append(drive(
         "raft_storm", fn, nc * nt, 2e9 * SCALE, raft_stats, seed0=2000,
+    ))
+
+    # --- 7-node storm (topology diversity): ~1e9 steps ---------------------
+    cfg7 = SimConfig(
+        n_nodes=7, p_client_cmd=0.2, loss_prob=0.2, p_crash=0.02,
+        p_restart=0.2, max_dead=3, p_repartition=0.04, p_heal=0.08,
+        p_leader_part=0.01, p_asym_cut=0.02,
+    )
+    fn = make_fuzz_fn(cfg7, nc, nt)
+    rows.append(drive(
+        "raft_storm_7node", fn, nc * nt, 1e9 * SCALE, raft_stats, seed0=2500,
     ))
 
     # --- knob grid (heterogeneous knobs, one program): ~1e9 steps ----------
@@ -197,6 +213,7 @@ def main() -> None:
         "wall_s": round(time.time() - t_start, 1),
         "device": dev,
         "scale": SCALE,
+        "seed_base": SEED_BASE,
         "regions": rows,
     }
     path = os.environ.get("SOAK_OUT")
