@@ -1,8 +1,32 @@
-"""Locate the hot phase of step_cluster by early-return surgery on its source."""
-import functools, time, sys, types, pathlib
-import jax, jax.numpy as jnp, numpy as np
+"""Phase-cost attribution for step_cluster by early-return surgery.
 
-SRC = pathlib.Path("/root/repo/madraft_tpu/tpusim/step.py").read_text()
+Methodology (hardened in round 3 — see PERF.md "Round-3 measurement
+caveat" and the verify skill's tunnel notes): the tunnel's ~63 ms
+per-call latency and ~±8% run-to-run spread make single-shot timings
+meaningless, so every variant is compiled up front and the timed runs are
+INTERLEAVED (round-robin across variants, direction alternating), with
+best-of reported. Deltas under ~10% are still noise — XLA dead-code-
+eliminates differently per truncated variant, so treat the output as a
+RANKING of phase cost, not an exact budget, and confirm any conclusion
+with a cut-one A/B of the specific phase (the /tmp harness pattern in
+PERF.md's kv/shardkv sections).
+
+Usage: python _ablate.py [n_clusters] [scan_len] [reps]
+"""
+import functools
+import json
+import pathlib
+import sys
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SRC = pathlib.Path(__file__).with_name("madraft_tpu").joinpath(
+    "tpusim", "step.py"
+).read_text()
 
 # Anchor = line that starts a section; we insert an early return just before it.
 RETURN = (
@@ -26,40 +50,67 @@ ANCHORS = [
     ("+oracle", "    # -------------------------------------------------------------- compaction"),
 ]
 
+
 def make_step(cut_anchor):
     src = SRC
     if cut_anchor is not None:
         i = src.index(cut_anchor)
         src = src[:i] + RETURN
     mod = types.ModuleType("step_var")
-    mod.__dict__["__name__"] = "step_var"
+    sys.modules["step_var"] = mod
     exec(compile(src, "step_var.py", "exec"), mod.__dict__)
     return mod.step_cluster
 
-from madraft_tpu.tpusim import SimConfig
-from madraft_tpu.tpusim.state import init_cluster
 
-cfg = SimConfig(n_nodes=5, p_client_cmd=0.2, loss_prob=0.1, p_crash=0.01,
-                p_restart=0.2, max_dead=2, p_repartition=0.02, p_heal=0.05)
-N = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
-L = 16
-base = jax.random.PRNGKey(0)
-keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(N))
-states = jax.block_until_ready(jax.vmap(functools.partial(init_cluster, cfg))(keys))
+def main():
+    from madraft_tpu.tpusim import SimConfig
+    from madraft_tpu.tpusim.state import init_cluster
 
-names = [n for n, _ in ANCHORS] + ["full"]
-cuts = [a for _, a in ANCHORS] + [None]
-prev = 0.0
-for name, cut in zip(names, cuts):
-    step = make_step(cut)
-    @jax.jit
-    def run(states, keys, step=step):
-        def body(c, _):
-            return jax.vmap(functools.partial(step, cfg))(c, keys), None
-        final, _ = jax.lax.scan(body, states, None, length=L)
-        return final
-    out = run(states, keys); _ = np.asarray(out.tick)  # compile+run+fetch
-    t0 = time.time(); out = run(states, keys); _ = np.asarray(out.tick)
-    dt = (time.time() - t0) / L * 1e3
-    print(f"{name:12s} {dt:8.2f} ms/tick  (delta {dt-prev:+8.2f})", flush=True)
-    prev = dt
+    cfg = SimConfig(n_nodes=5, p_client_cmd=0.2, loss_prob=0.1, p_crash=0.01,
+                    p_restart=0.2, max_dead=2, p_repartition=0.02, p_heal=0.05)
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    scan_len = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    reps = int(sys.argv[3]) if len(sys.argv) > 3 else 6
+    base = jax.random.PRNGKey(0)
+    keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.arange(n))
+    state0 = jax.block_until_ready(
+        jax.vmap(functools.partial(init_cluster, cfg))(keys)
+    )
+
+    names = [nm for nm, _ in ANCHORS] + ["full"]
+    cuts = [a for _, a in ANCHORS] + [None]
+    runs = {}
+    for name, cut in zip(names, cuts):
+        step = make_step(cut)
+
+        @jax.jit
+        def run(states, keys, step=step):
+            def body(c, _):
+                return jax.vmap(functools.partial(step, cfg))(c, keys), None
+            return jax.lax.scan(body, states, None, length=scan_len)[0]
+
+        _ = np.asarray(run(state0, keys).tick)  # compile + warm
+        runs[name] = run
+
+    times = {name: [] for name in names}
+    for r in range(reps):
+        order = names if r % 2 == 0 else names[::-1]
+        for name in order:
+            t0 = time.perf_counter()
+            _ = np.asarray(runs[name](state0, keys).tick)
+            times[name].append(time.perf_counter() - t0)
+
+    prev = 0.0
+    for name in names:
+        best = min(times[name]) / scan_len * 1e3
+        print(json.dumps({
+            "variant": name,
+            "ms_per_tick": round(best, 3),
+            "delta_ms": round(best - prev, 3),
+            "runs": reps,
+        }), flush=True)
+        prev = best
+
+
+if __name__ == "__main__":
+    main()
