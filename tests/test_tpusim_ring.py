@@ -1,0 +1,133 @@
+"""Unit tests for the step function's foundations: the canonical-ring
+algebra and the packed PRNG draw block (step.py). The rest of the suite is
+integration tests on simulated clusters (the reference's strategy, SURVEY.md
+§4); these pin down the two pure-function layers everything rests on —
+the invariants the docstrings promise, checked directly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madraft_tpu.tpusim.step import (
+    _block_total,
+    _DrawBlock,
+    _entry_mix,
+    _lane_abs,
+    _net_draws,
+    _slot,
+)
+from madraft_tpu.tpusim import SimConfig
+
+
+def test_ring_lane_is_canonical_and_stable():
+    # The lane of an absolute index NEVER depends on the window: _slot is a
+    # pure function of the index, so compaction (a base bump) moves no data.
+    cap = 64
+    idx = jnp.arange(1, 5 * cap + 1, dtype=jnp.int32)
+    lanes = _slot(idx, cap)
+    assert lanes.min() >= 0 and lanes.max() < cap
+    # index a and a+cap share a lane; nothing nearer does
+    np.testing.assert_array_equal(np.asarray(lanes[:cap]), np.asarray(lanes[cap:2 * cap]))
+    assert len(set(np.asarray(lanes[:cap]).tolist())) == cap
+
+
+def test_lane_abs_inverts_slot_over_the_window():
+    # _lane_abs(base)[k] is THE unique a in (base, base+cap] with
+    # _slot(a) == k — the round-trip that makes one one-hot serve both the
+    # sender read and the receiver write in the AE delivery.
+    cap = 32
+    for base in (0, 1, 31, 32, 33, 1000):
+        abs_arr = _lane_abs(jnp.asarray(base, jnp.int32), cap)
+        assert abs_arr.shape == (cap,)
+        a = np.asarray(abs_arr)
+        assert a.min() == base + 1 and a.max() == base + cap
+        np.testing.assert_array_equal(
+            np.asarray(_slot(abs_arr, cap)), np.arange(cap)
+        )
+
+
+def test_entry_mix_fold_is_order_free_but_position_sensitive():
+    # XOR-folding _entry_mix over a set of entries must not depend on fold
+    # order (compaction folds batches in one vectorized pass), but MUST
+    # depend on each entry's position, term, and value.
+    t = jnp.asarray([3, 5, 7], jnp.int32)
+    v = jnp.asarray([11, 13, 17], jnp.int32)
+    a = jnp.asarray([1, 2, 3], jnp.int32)
+    h = np.asarray(_entry_mix(t, v, a))
+    fold_fwd = h[0] ^ h[1] ^ h[2]
+    fold_rev = h[2] ^ h[0] ^ h[1]
+    assert fold_fwd == fold_rev
+    # swapping two entries' positions changes the fold
+    a_sw = jnp.asarray([2, 1, 3], jnp.int32)
+    h_sw = np.asarray(_entry_mix(t, v, a_sw))
+    assert (h_sw[0] ^ h_sw[1] ^ h_sw[2]) != fold_fwd
+    # and so does changing a term or a value
+    assert int(_entry_mix(t[0] + 1, v[0], a[0])) != int(h[0])
+    assert int(_entry_mix(t[0], v[0] + 1, a[0])) != int(h[0])
+
+
+def _blk(seed, total):
+    return _DrawBlock(jax.random.PRNGKey(seed), total)
+
+
+def test_draw_block_budget_is_exact():
+    # step_cluster slices the tick's whole randomness budget from one
+    # threefry call; _block_total must cover exactly what a tick takes.
+    # (Consuming more would read out of bounds silently via numpy clipping —
+    # this pins the arithmetic.)
+    from madraft_tpu.tpusim.state import init_cluster
+    from madraft_tpu.tpusim.step import step_cluster
+
+    counted = {}
+
+    class CountingBlock(_DrawBlock):
+        def _take(self, shape):
+            out = super()._take(shape)
+            counted["off"] = self.off
+            return out
+
+    import madraft_tpu.tpusim.step as step_mod
+
+    orig = step_mod._DrawBlock
+    step_mod._DrawBlock = CountingBlock
+    try:
+        for n in (3, 5, 7):
+            cfg = SimConfig(n_nodes=n, p_client_cmd=0.2, loss_prob=0.1,
+                            p_repartition=0.02, p_heal=0.05)
+            counted.clear()
+            key = jax.random.PRNGKey(0)
+            st = init_cluster(cfg, key)
+            _ = step_cluster(cfg, st, key)
+            assert counted["off"] == _block_total(n), (
+                f"n={n}: consumed {counted['off']} of {_block_total(n)}"
+            )
+    finally:
+        step_mod._DrawBlock = orig
+
+
+def test_randint_and_u01_bounds():
+    blk = _blk(7, 4096 * 3)
+    u = np.asarray(blk.uniform((4096,)))
+    assert (u >= 0).all() and (u < 1.0).all()
+    # p=1.0 fires ALWAYS (the round-2 advisory corner: no round-up-to-1.0)
+    assert np.asarray(blk.bern(1.0, (2048,))).all()
+    r = np.asarray(blk.randint(5, 12, (1024,)))
+    assert r.min() >= 5 and r.max() <= 11
+    assert len(set(r.tolist())) == 7  # every value drawable
+
+
+def test_net_draws_delay_range_and_loss_extremes():
+    cfg = SimConfig(n_nodes=3, delay_min=2, delay_max=5)
+    kn = cfg.knobs()
+    blk = _blk(9, 4096)
+    delay, lost = _net_draws(kn, blk, (2048,))
+    d = np.asarray(delay)
+    assert d.min() >= 2 and d.max() <= 5
+    assert len(set(d.tolist())) == 4  # every delay in the span drawable
+    # loss_prob=0 loses nothing; =1 loses everything
+    blk = _blk(9, 4096)
+    _, l0 = _net_draws(cfg.replace(loss_prob=0.0).knobs(), blk, (1024,))
+    _, l1 = _net_draws(cfg.replace(loss_prob=1.0).knobs(), blk, (1024,))
+    assert not np.asarray(l0).any()
+    assert np.asarray(l1).all()
