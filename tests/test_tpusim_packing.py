@@ -1,0 +1,81 @@
+"""Round-trip unit tests for the i32 log-value packings (kv.py, shardkv.py).
+
+Every oracle and apply machine keys off these encodings — a collision or a
+round-trip failure would silently corrupt dedup tables and truth counts, so
+the bijectivity and the non-zero guarantee (0 is the empty-lane sentinel,
+NOOP_CMD is the leader no-op) are pinned directly over the full domains the
+fuzzers use."""
+
+import numpy as np
+
+from madraft_tpu.tpusim.config import NOOP_CMD
+from madraft_tpu.tpusim import kv as kvm
+from madraft_tpu.tpusim import shardkv as skvm
+
+
+def test_kv_pack_roundtrip_and_uniqueness():
+    cfg = kvm.KvConfig(n_clients=4, n_keys=4)
+    seen = set()
+    for client in range(cfg.n_clients):
+        for seq in (0, 1, 2, kvm._SEQ_LIM - 1):
+            for key in range(cfg.n_keys):
+                for kind in (kvm._APPEND, kvm._GET):
+                    v = int(kvm._pack(cfg, client, seq, key, kind))
+                    assert v != 0 and v != NOOP_CMD
+                    assert v not in seen
+                    seen.add(v)
+                    c, s, k, kd = kvm._unpack(cfg, np.int32(v))
+                    assert (int(c), int(s), int(k), int(kd)) == (
+                        client, seq, key, kind
+                    )
+
+
+def test_kv_pack_fits_i32_at_limits():
+    cfg = kvm.KvConfig(n_clients=8, n_keys=8)
+    v = kvm._pack(cfg, cfg.n_clients - 1, kvm._SEQ_LIM - 1, cfg.n_keys - 1, 1)
+    assert 0 < int(v) < 2**31
+
+
+def test_shardkv_op_pack_roundtrip():
+    cfg = skvm.ShardKvConfig()
+    seen = set()
+    for client in range(cfg.n_clients):
+        for seq in (0, 1, skvm._SEQ_LIM - 1):
+            for shard in range(cfg.n_shards):
+                for kind in (skvm._APPEND, skvm._GET):
+                    v = int(skvm._pack_op(cfg, client, seq, shard, kind))
+                    assert v != 0 and v not in seen
+                    seen.add(v)
+                    kd, c, s, sh, _, _ = skvm._unpack(cfg, np.int32(v))
+                    assert (int(kd), int(c), int(s), int(sh)) == (
+                        kind, client, seq, shard
+                    )
+
+
+def test_shardkv_marker_packs_roundtrip_disjoint():
+    # CONFIG / INSTALL / DELETE markers must round-trip their own payloads
+    # and never collide with each other or with client ops.
+    cfg = skvm.ShardKvConfig()
+    seen = set()
+    for c in range(cfg.n_configs):
+        v = int(skvm._pack_config(np.int32(c)))
+        kd, _, _, _, cfg_c, _ = skvm._unpack(cfg, np.int32(v))
+        assert int(kd) == skvm._CONFIG and int(cfg_c) == c
+        assert v not in seen
+        seen.add(v)
+        for shard in range(cfg.n_shards):
+            vi = int(skvm._pack_install(cfg, np.int32(c), np.int32(shard)))
+            vd = int(skvm._pack_delete(cfg, np.int32(c), np.int32(shard)))
+            for v2, want_kind in ((vi, skvm._INSTALL), (vd, skvm._DELETE)):
+                kd, _, _, sh, _, cfg_i = skvm._unpack(cfg, np.int32(v2))
+                assert int(kd) == want_kind
+                assert int(sh) == shard and int(cfg_i) == c
+                assert v2 not in seen
+                seen.add(v2)
+    # kinds live in disjoint mod-8 classes, so ops can never alias markers:
+    # every marker's class differs from BOTH op kinds
+    op_kinds = {skvm._APPEND, skvm._GET}
+    for kind in op_kinds:
+        op = int(skvm._pack_op(cfg, 0, 0, 0, kind))
+        assert (op - 1) % 8 == kind
+    assert all((v - 1) % 8 not in op_kinds for v in seen)
