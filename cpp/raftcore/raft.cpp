@@ -2,6 +2,7 @@
 
 #include <algorithm>
 #include <cstdlib>
+#include <cstring>
 
 namespace raftcore {
 
@@ -20,6 +21,16 @@ static size_t quorum(size_t n_peers) {
   // peers_.size() - quorum() index in advance_commit
   return override_v > 0 ? std::min((size_t)override_v, n_peers)
                         : n_peers / 2 + 1;
+}
+
+// The planted-bug library (mirrors the TPU backend's SimConfig.bug /
+// config.py RAFT_BUGS): MADTPU_BUG names one classic Raft implementation
+// bug to inject, so a violation class the batched fuzzer finds under a bug
+// replays here with the same bug for differential cross-validation. Read
+// per call for the same reason quorum() is.
+static bool bug(const char* name) {
+  const char* e = std::getenv("MADTPU_BUG");
+  return e && !std::strcmp(e, name);
 }
 
 // ------------------------------------------------------------------- boot
@@ -95,7 +106,7 @@ RequestVoteReply Raft::handle_request_vote(const RequestVoteArgs& a) {
   if (a.term == term_ && (voted_for_ == -1 || voted_for_ == (int)a.candidate)) {
     // election restriction (§5.4.1): candidate's log at least as up-to-date
     uint64_t my_llt = term_at(last_index());
-    if (a.last_log_term > my_llt ||
+    if (bug("grant_any_vote") || a.last_log_term > my_llt ||
         (a.last_log_term == my_llt && a.last_log_index >= last_index())) {
       grant = true;
       voted_for_ = (int)a.candidate;
@@ -139,10 +150,11 @@ AppendEntriesReply Raft::handle_append_entries(const AppendEntriesArgs& a) {
   // append, truncating at the first conflict (never truncate on a match —
   // a delayed short AE must not drop entries a newer one appended)
   uint64_t idx = prev_index;
+  const bool no_trunc = bug("no_truncate");  // hoisted: one env read per call
   for (size_t k = skip; k < a.entries.size(); k++) {
     idx = prev_index + (k - skip) + 1;
     if (idx <= last_index()) {
-      if (term_at(idx) != a.entries[k].term) {
+      if (term_at(idx) != a.entries[k].term && !no_trunc) {
         log_.resize(idx - snap_last_index_ - 1);
         log_.push_back(a.entries[k]);
         log_dirty = true;
@@ -376,7 +388,7 @@ void Raft::advance_commit() {
   uint64_t majority_match = m[peers_.size() - quorum(peers_.size())];
   // only commit entries from the current term (Raft §5.4.2, Figure 8)
   if (majority_match > commit_ && majority_match > snap_last_index_ &&
-      term_at(majority_match) == term_) {
+      (term_at(majority_match) == term_ || bug("commit_any_term"))) {
     MT_LOG("raft", "leader %zu advances commit %llu -> %llu", me_,
            (unsigned long long)commit_, (unsigned long long)majority_match);
     commit_ = majority_match;
@@ -430,6 +442,9 @@ void Raft::restore() {
   Dec d(*st);
   term_ = d.u64();
   voted_for_ = (int)d.u64() - 1;
+  // planted bug (config.py RAFT_BUGS): votedFor "not persisted" — modeled
+  // at restore so the persist()-side file contract stays byte-identical
+  if (bug("forget_voted_for")) voted_for_ = -1;
   snap_last_index_ = d.u64();
   snap_last_term_ = d.u64();
   uint64_t n = d.u64();

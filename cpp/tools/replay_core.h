@@ -36,6 +36,8 @@ struct Schedule {
   uint64_t ms_per_tick = 10;
   uint64_t ticks = 0;
   int majority_override = 0;
+  std::string bug;                // planted bug name ("" = correct algorithm;
+  //                                 raftcore raft.cpp bug(), config.py RAFT_BUGS)
   uint64_t seed = 0;
   std::vector<Event> events;      // sorted by tick
 };
@@ -54,6 +56,16 @@ inline bool parse_schedule(FILE* f, Schedule* out) {
       std::sscanf(line, "%*s %" SCNu64, &out->ticks);
     } else if (!std::strcmp(kw, "majority_override")) {
       std::sscanf(line, "%*s %d", &out->majority_override);
+    } else if (!std::strcmp(kw, "bug")) {
+      char name[64] = {0};
+      if (std::sscanf(line, "%*s %63s", name) == 1) out->bug = name;
+      // Reject names raftcore doesn't implement (keep in sync with
+      // raft.cpp's bug() sites / config.py RAFT_BUGS): a silently-ignored
+      // bug would make a clean replay read as "TPU false positive" when
+      // the bug was simply never injected.
+      if (out->bug != "commit_any_term" && out->bug != "grant_any_vote" &&
+          out->bug != "forget_voted_for" && out->bug != "no_truncate")
+        return false;
     } else if (!std::strcmp(kw, "seed")) {
       std::sscanf(line, "%*s %" SCNu64, &out->seed);
     } else if (!std::strcmp(kw, "ev")) {
@@ -227,6 +239,8 @@ inline std::string run_schedule(const Schedule& sch) {
   madtpu_tools::EnvGuard guard(
       "MADTPU_MAJORITY_OVERRIDE",
       sch.majority_override > 0 ? buf : nullptr);
+  madtpu_tools::EnvGuard bug_guard(
+      "MADTPU_BUG", !sch.bug.empty() ? sch.bug.c_str() : nullptr);
   Sim sim(sch.seed);
   Replay r(&sim, sch.nodes);
   if (!sim.run(replay_driver(&sim, &r, &sch))) return "";

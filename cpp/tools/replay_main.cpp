@@ -15,6 +15,7 @@
 //   ms_per_tick <ms>
 //   ticks <t_end>
 //   majority_override <q>      # 0 = correct quorum
+//   bug <name>                 # planted bug (config.py RAFT_BUGS), optional
 //   seed <u64>                 # simcore PRNG seed (timeout draws etc.)
 //   ev <tick> alive <hexmask>  # bit i = node i alive from this tick on
 //   ev <tick> adj <hexrow0> <hexrow1> ...  # row i bit j = link i<->j usable

@@ -55,6 +55,8 @@ class Schedule:
     n_ticks: int
     majority_override: int            # 0 = correct quorum
     seed: int                         # simcore PRNG seed for the replay
+    bug: str = ""                     # planted bug name ("" = correct;
+    #                                   config.py RAFT_BUGS <-> MADTPU_BUG)
     # (tick, alive_bitmask) and (tick, adj row bitmasks) change events
     alive_events: list[tuple[int, int]] = dataclasses.field(default_factory=list)
     adj_events: list[tuple[int, list[int]]] = dataclasses.field(default_factory=list)
@@ -70,6 +72,8 @@ class Schedule:
             f"majority_override {self.majority_override}",
             f"seed {self.seed}",
         ]
+        if self.bug:
+            lines.insert(-1, f"bug {self.bug}")
         events = [(t, "alive", f"{m:x}") for t, m in self.alive_events] + [
             (t, "adj", " ".join(f"{r:x}" for r in rows))
             for t, rows in self.adj_events
@@ -128,6 +132,7 @@ def extract_schedule(
         ms_per_tick=cfg.ms_per_tick,
         n_ticks=n_ticks,
         majority_override=cfg.majority_override or 0,
+        bug=cfg.bug,
         seed=seed,
     )
     prev_alive = _bitmask(np.ones(cfg.n_nodes, bool))

@@ -31,6 +31,12 @@ import jax
 import jax.numpy as jnp
 
 
+# The raft-layer planted-bug library (see SimConfig.bug).
+RAFT_BUGS = (
+    "", "commit_any_term", "grant_any_vote", "forget_voted_for", "no_truncate",
+)
+
+
 @dataclasses.dataclass(frozen=True)
 class SimConfig:
     """Static parameters of one batched simulation. All times are in ticks."""
@@ -56,6 +62,8 @@ class SimConfig:
                 f"({self.compact_every}) must stay below log_cap "
                 f"({self.log_cap}) or a full ring can deadlock commit"
             )
+        if self.bug not in RAFT_BUGS:
+            raise ValueError(f"unknown bug {self.bug!r}; known: {RAFT_BUGS}")
 
     # Log compaction (the Lab 2D snapshot path, raft.rs:149-168): a node
     # discards its window prefix up to the compaction boundary every
@@ -116,6 +124,21 @@ class SimConfig:
     # which the election-safety oracle must flag.
     majority_override: int | None = None
 
+    # Planted-bug library (mutation testing for the oracles): "" = correct
+    # algorithm; otherwise one of the classic Raft implementation bugs, each
+    # of which a specific oracle must catch (tests/test_tpusim_bugs.py) and
+    # each of which the C++ backend mirrors via MADTPU_BUG for differential
+    # replay (cpp/raftcore/raft.cpp quorum()/bug() knobs):
+    #   "commit_any_term"  - leader counts replicas for OLD-term entries too
+    #                        (drops the §5.4.2/Figure-8 current-term rule)
+    #   "grant_any_vote"   - voter skips the §5.4.1 up-to-date log check
+    #   "forget_voted_for" - votedFor is not persisted across a crash
+    #   "no_truncate"      - follower appends past its end but never
+    #                        overwrites/truncates a conflicting suffix
+    # Static (trace-time) on purpose: the correct program carries zero
+    # bug-branch cost, and a bug selects its own compiled program.
+    bug: str = ""
+
     @property
     def majority(self) -> int:
         if self.majority_override is not None:
@@ -156,7 +179,7 @@ class SimConfig:
         flow/compaction margin check satisfiable at any log_cap)."""
         return SimConfig(
             n_nodes=self.n_nodes, log_cap=self.log_cap, ae_max=self.ae_max,
-            compact_every=1,
+            compact_every=1, bug=self.bug,
         )
 
 

@@ -223,6 +223,11 @@ def step_cluster(
     # the volatile set resets — raft.rs:194-211 restore(), tester.rs:284-327).
     # The snapshot covers 1..base, so commit restarts at base, not 0.
     role = jnp.where(restart, FOLLOWER, s.role)
+    if cfg.bug == "forget_voted_for":
+        # planted bug: votedFor not persisted — a restarted node may re-vote
+        # in a term it already voted in (two leaders share the term; the
+        # election-safety oracle must fire). config.py RAFT_BUGS.
+        s = s._replace(voted_for=jnp.where(restart, -1, s.voted_for))
     timer = jnp.where(restart, _timeout_draw(kn, blk, (n,)), s.timer)
     hb = jnp.where(restart, 0, s.hb)
     commit = jnp.where(restart, s.base, s.commit)
@@ -425,6 +430,11 @@ def step_cluster(
     cand_llt = picked(pick, s.rv_req_llt)
     cand_lli = picked(pick, s.rv_req_lli)
     log_ok = (cand_llt > my_llt) | ((cand_llt == my_llt) & (cand_lli >= log_len))
+    if cfg.bug == "grant_any_vote":
+        # planted bug: skip the §5.4.1 up-to-date check — a stale-log
+        # candidate can win and overwrite committed entries (commit-shadow
+        # oracle must fire). config.py RAFT_BUGS.
+        log_ok = jnp.ones_like(log_ok)
     src_id = picked(pick, jnp.broadcast_to(me[None, :], (n, n)))
     grant = got & (mterm == term) & (
         (voted_for == -1) | (voted_for == src_id)
@@ -520,6 +530,11 @@ def step_cluster(
         success[:, None] & (e_ar[None, :] < nent[:, None])
         & (abs_e > base[:, None]) & (abs_e <= (base + cap)[:, None])
     )
+    if cfg.bug == "no_truncate":
+        # planted bug: append only past the end — a conflicting suffix is
+        # never overwritten or truncated (log-matching oracle must fire).
+        # config.py RAFT_BUGS.
+        in_batch = in_batch & (abs_e > log_len[:, None])
     # the canonical ring makes the sender read lane and the receiver write
     # lane the SAME mask — one one-hot serves both
     slot_oh = lane[:, None, :] == _slot(abs_e, cap)[..., None]  # [n, e, cap]
@@ -540,6 +555,9 @@ def step_cluster(
     batch_end = jnp.minimum(prev + nent, base + cap)  # ring overflow: drop tail
     # Conflict => truncate to the rewritten batch; otherwise never shrink
     # (a heartbeat must not drop entries a newer AE already appended).
+    # (under bug == "no_truncate", conflict_any is vacuously False: in_batch
+    # was restricted to abs_e > log_len above, so the conflict conjunction
+    # (abs_e <= log_len) can never hold — the buggy log only ever grows)
     log_len = jnp.where(
         success,
         jnp.where(conflict_any, batch_end, jnp.maximum(log_len, batch_end)),
@@ -696,6 +714,11 @@ def step_cluster(
     cur_term_ok = (kth > base) & (
         _term_at(log_term, snap_term, base, kth, cap) == term
     )
+    if cfg.bug == "commit_any_term":
+        # planted bug: drop the §5.4.2 current-term commit rule — the exact
+        # Figure-8 mistake (commit by counting replicas of an old-term
+        # entry); the commit-shadow oracle must fire. config.py RAFT_BUGS.
+        cur_term_ok = kth > base
     commit = jnp.where(lead & cur_term_ok, jnp.maximum(commit, kth), commit)
 
     # ------------------------------------------------------------------- oracle

@@ -205,3 +205,43 @@ def test_bridge_clean_on_correct_quorum():
     cpp = bridge.replay_on_simcore(sched, binary=binary)
     assert not cpp["dual_leader"] and not cpp["commit_mismatch"], cpp
     assert cpp["max_applied"] > 0, "replay must make progress"
+
+
+def test_bridge_replays_planted_bug_classes():
+    """The planted-bug library crosses the bridge: a violation the batched
+    fuzzer finds under SimConfig.bug replays on the C++ backend with the
+    SAME bug injected (the schedule carries a `bug` line -> MADTPU_BUG,
+    cpp/raftcore/raft.cpp) and must reproduce the violation class; the same
+    schedule with the bug stripped must replay clean — the bug, not the
+    fault schedule, is what breaks safety. (Measured odds: ~16/16 schedules
+    class-match for these two bugs; commit_any_term / forget_voted_for have
+    much thinner per-schedule odds on the C++ side's independent election
+    timing, so the cross-backend leg pins the two robust ones and
+    tests/test_tpusim_bugs.py covers all four on the batched side.)"""
+    import dataclasses
+
+    from tests.test_tpusim_bugs import STORM as storm  # single tuned profile
+
+    binary = _ensure_replay_binary()
+    n_ticks = 600
+    for bug, seed in (("grant_any_vote", 9), ("no_truncate", 11)):
+        cfg = storm.replace(bug=bug)
+        rep = fuzz(cfg, seed=seed, n_clusters=64, n_ticks=n_ticks)
+        bad = rep.violating_clusters()
+        assert bad.size > 0, f"{bug}: no TPU violations to bridge"
+        matched = 0
+        for cid in bad[:3]:
+            sched = bridge.extract_schedule(cfg, seed=seed, cluster_id=int(cid),
+                                            n_ticks=n_ticks)
+            assert sched.bug == bug  # rides the schedule into MADTPU_BUG
+            cpp = bridge.replay_on_simcore(sched, binary=binary)
+            if bridge.classes_match(sched.violations, cpp):
+                matched += 1
+            clean = bridge.replay_on_simcore(
+                dataclasses.replace(sched, bug=""), binary=binary
+            )
+            assert not (clean["dual_leader"] or clean["commit_mismatch"]
+                        or clean["apply_disorder"]), (
+                f"{bug}: clean replay of the same schedule violated: {clean}"
+            )
+        assert matched > 0, f"{bug}: no C++ replay reproduced the class"

@@ -24,7 +24,7 @@ SIM_FOLDED = {
 
 
 def test_simconfig_fields_all_reach_the_program():
-    static = {"n_nodes", "log_cap", "ae_max"}  # static_key's explicit fields
+    static = {"n_nodes", "log_cap", "ae_max", "bug"}  # static_key's fields
     knob_names = set(Knobs._fields)
     for f in dataclasses.fields(SimConfig):
         if f.name in SIM_DOC_ONLY or f.name in static:
