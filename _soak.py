@@ -178,7 +178,7 @@ def main() -> None:
         p_client_cmd=0.0, compact_at_commit=False, compact_every=16
     )
     nck, ntk = 1024, 1024
-    fn = make_kv_fuzz_fn(kcfg, KvConfig(p_get=0.3), nck, ntk)
+    fn = make_kv_fuzz_fn(kcfg, KvConfig(p_get=0.3, p_put=0.2), nck, ntk)
     rows.append(drive(
         "kv_fuzz", fn, nck * ntk, 5e8 * SCALE,
         lambda f: (np.asarray(f.raft.violations),
@@ -191,7 +191,7 @@ def main() -> None:
         n_nodes=3, p_client_cmd=0.0, compact_at_commit=False, log_cap=64,
         compact_every=16, loss_prob=0.05,
     )
-    skcfg = ShardKvConfig()
+    skcfg = ShardKvConfig(p_put=0.2)  # full op set: Get/Put/Append
     ncs, nts = 256, 512
     fn = make_shardkv_fuzz_fn(scfg, skcfg, ncs, nts)
 

@@ -43,6 +43,8 @@ struct Schedule {
   uint64_t ms_per_tick = 10;
   uint64_t seed = 0;
   std::string bug = "none";
+  std::string raft_bug;             // raft-layer planted bug (MADTPU_BUG,
+  //                                   raftcore raft.cpp / config.py RAFT_BUGS)
   std::vector<CfgEvent> cfgs;       // sorted by tick
   std::vector<AliveEvent> alives;   // sorted by tick
 };
@@ -66,6 +68,15 @@ inline bool parse_schedule(FILE* f, Schedule* out) {
     } else if (!std::strcmp(kw, "bug")) {
       char b[64];
       if (std::sscanf(line, "%*s %63s", b) == 1) out->bug = b;
+    } else if (!std::strcmp(kw, "raft_bug")) {
+      char b[64] = {0};
+      if (std::sscanf(line, "%*s %63s", b) == 1) out->raft_bug = b;
+      // same guard as replay_core.h: a silently-ignored bug name would make
+      // a clean replay read as "TPU false positive"
+      if (out->raft_bug != "commit_any_term" &&
+          out->raft_bug != "grant_any_vote" &&
+          out->raft_bug != "forget_voted_for" && out->raft_bug != "no_truncate")
+        return false;
     } else if (!std::strcmp(kw, "cfg")) {
       CfgEvent ev;
       int consumed = 0;
@@ -228,6 +239,8 @@ inline Task<void> replay_driver(Sim* sim, ShardKvTester* t, Flags* fl,
 inline std::string run_schedule(const Schedule& sch) {
   madtpu_tools::EnvGuard guard(
       "MADTPU_SHARDKV_BUG", sch.bug != "none" ? sch.bug.c_str() : nullptr);
+  madtpu_tools::EnvGuard raft_guard(
+      "MADTPU_BUG", !sch.raft_bug.empty() ? sch.raft_bug.c_str() : nullptr);
   std::string out;
   if (sch.groups <= ShardKvTester::N_GROUPS) {
     Sim sim(sch.seed);

@@ -191,12 +191,13 @@ def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
     """Re-run ONE KV-fuzz cluster and export its op history as HistOp lines
     for the C++ Wing-Gong checker (cpp/tools/lincheck_main.cpp).
 
-    Value translation: the TPU oracle observes per-key applied-APPEND COUNTS;
-    the checker works on append-string states. Since every node applies the
-    same committed order, observing count k is exactly observing the
-    concatenation of the first k committed appends to that key (in shadow
-    order), so each Get's output becomes that prefix string and each Append's
-    input its unique token. The committed order is STREAMED from the per-tick
+    Value translation: the TPU oracle observes per-key MUTATION VERSIONS;
+    the checker works on value strings. Since every node applies the same
+    committed order, observing version v is exactly observing the value after
+    the first v committed mutations to that key (in shadow order): the last
+    Put's token concatenated with the Appends after it. Each Get's output
+    becomes that string and each mutation's input its unique token
+    ("a{c}.{s};" / "p{c}.{s};"). The committed order is STREAMED from the per-tick
     shadow trace (each tick's newly-committed lanes are read while still in
     window), so the export works for runs of arbitrary length — far past one
     shadow window of ``log_cap`` entries (the round-2 limitation).
@@ -205,7 +206,14 @@ def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
     violation bitmask.
     """
     # local import: keep the raft-only bridge importable without the kv layer
-    from madraft_tpu.tpusim.kv import _APPEND, _GET, _unpack, init_kv_cluster, kv_step
+    from madraft_tpu.tpusim.config import NOOP_CMD
+    from madraft_tpu.tpusim.kv import (
+        _GET,
+        _PUT,
+        _unpack,
+        init_kv_cluster,
+        kv_step,
+    )
 
     ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
 
@@ -235,7 +243,11 @@ def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
     # are still live in that tick's window, so reading them tick by tick
     # reconstructs the full order no matter how far the window slid since.
     cap = sh_val_t.shape[1]
-    appends_by_key: dict[int, list[str]] = {}
+    # committed MUTATION order per key (appends and puts), deduped; a key's
+    # version v maps to the value string after its first v mutations — the
+    # last put's token plus the appends after it (Put replaces, Append
+    # concatenates; cpp/kvraft/kv.h apply semantics)
+    muts_by_key: dict[int, list[tuple[int, str]]] = {}
     seen = set()
     seen_len = 0
     for ti in range(sh_len_t.shape[0]):
@@ -244,11 +256,24 @@ def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
             # one source of truth for the ring-lane math (step.py)
             val = int(sh_val_t[ti][int(_slot(a, cap))])
             c, s, k, kind = _unpack(kcfg, val)
-            if kind != _APPEND or val in seen:
+            # leader no-ops are not client ops (they unpack to kind 3, which
+            # the old two-kind filter excluded implicitly — skip explicitly)
+            if val == NOOP_CMD or kind == _GET or val in seen:
                 continue
             seen.add(val)
-            appends_by_key.setdefault(int(k), []).append(f"a{int(c)}.{int(s)};")
+            tag = "p" if kind == _PUT else "a"
+            muts_by_key.setdefault(int(k), []).append(
+                (int(kind), f"{tag}{int(c)}.{int(s)};")
+            )
         seen_len = max(seen_len, ln)
+
+    def _state_at(k: int, v: int) -> str:
+        muts = muts_by_key.get(k, [])[:v]
+        lo = 0
+        for i, (kind, _) in enumerate(muts):
+            if kind == _PUT:
+                lo = i  # put replaces: value restarts at its own token
+        return "".join(tok for _, tok in muts[lo:])
 
     nc = kcfg.n_clients
     lines = []
@@ -272,17 +297,17 @@ def extract_kv_history(cfg, kcfg, seed: int, cluster_id: int, n_ticks: int):
                 obs = int(obs_t[ret_idx, c])
                 if obs < 0:
                     continue  # defensive: completed Get must carry its obs
-                prefix = "".join(appends_by_key.get(key, [])[:obs])
                 lines.append(
-                    f"op {invoke} {ret_idx + 1} get k{key} {prefix}"
+                    f"op {invoke} {ret_idx + 1} get k{key} {_state_at(key, obs)}"
                 )
             else:
-                # a pending append may still have taken effect: close it at
+                # a pending mutation may still have taken effect: close it at
                 # the horizon so the checker may linearize it anywhere after
                 # invoke (sound; dropping it could fault a correct history)
                 ret = (ret_idx + 1) if ret_idx is not None else (T + 1)
+                verb, tag = ("put", "p") if kind == _PUT else ("append", "a")
                 lines.append(
-                    f"op {invoke} {ret} append k{key} a{c}.{s};"
+                    f"op {invoke} {ret} {verb} k{key} {tag}{c}.{s};"
                 )
     return lines, int(final.raft.violations)
 
@@ -341,7 +366,9 @@ class ShardKvSchedule:
     ms_per_tick: int
     n_ticks: int
     seed: int
-    bug: str = "none"  # none | drop_dup_table | serve_frozen
+    bug: str = "none"  # none | drop_dup_table | serve_frozen (service layer)
+    raft_bug: str = ""  # raft-layer planted bug (config.py RAFT_BUGS ->
+    #                     MADTPU_BUG), same contract as the raw-raft leg
     cfg_events: list[tuple[int, list[int]]] = dataclasses.field(
         default_factory=list
     )  # (activation tick, owner group per shard)
@@ -361,6 +388,8 @@ class ShardKvSchedule:
             f"seed {self.seed}",
             f"bug {self.bug}",
         ]
+        if self.raft_bug:
+            lines.append(f"raft_bug {self.raft_bug}")
         for t, owners in self.cfg_events:
             lines.append(f"cfg {t} " + " ".join(str(o) for o in owners))
         for t, g, m in self.alive_events:
@@ -401,6 +430,7 @@ def extract_shardkv_schedule(cfg, kcfg, seed: int, cluster_id: int,
             else "serve_frozen" if kcfg.bug_serve_frozen
             else "none"
         ),
+        raft_bug=cfg.bug,
     )
     cfg_tick = np.asarray(final.cfg_tick)
     cfg_owner = np.asarray(final.cfg_owner)

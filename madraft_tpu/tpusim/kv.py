@@ -56,7 +56,11 @@ VIOLATION_STALE_READ = 32    # a Get observed a state outside its invoke..return
 #                              linearization window (reads linearizability)
 
 _SEQ_LIM = 1 << 15  # packing limit: seq fits 15 bits
-_APPEND, _GET = 0, 1  # op kinds (the reference's Op::{Append,Get}, msg.rs:3-8)
+# Op kinds — the reference's full Op set (msg.rs:3-8). Put REPLACES a key's
+# value; on the count model a key's observable state is its MUTATION VERSION
+# (appends + puts applied), which stays monotone, so the reads-linearizability
+# interval oracle is exact with Puts in the mix (see KvState docstring).
+_APPEND, _GET, _PUT = 0, 1, 2
 
 # PRNG site ids, disjoint from step.py's _S_STEP_BLOCK (0).
 _S_CLERK_START, _S_CLERK_TARGET, _S_CLERK_RETRY, _S_CLERK_KEY = 8, 9, 10, 11
@@ -73,7 +77,11 @@ class KvConfig:
     n_clients: int = 4
     n_keys: int = 4
     p_op: float = 0.3           # idle clerk starts a fresh op
-    p_get: float = 0.3          # a fresh op is a Get (else an Append)
+    p_get: float = 0.3          # a fresh op is a Get with this probability,
+    p_put: float = 0.0          # a Put with this one (one uniform draw:
+    #                             u < p_get -> Get, u < p_get + p_put -> Put),
+    #                             an Append otherwise — the reference's full
+    #                             Op::{Get,Put,Append} set (msg.rs:3-8)
     p_retry: float = 0.5        # pending clerk re-submits this tick
     apply_max: int = 4          # apply-machine entries per node per tick
     # Oracle-validation bug modes (None/False = correct service).
@@ -91,6 +99,7 @@ class KvConfig:
         return KvKnobs(
             p_op=jnp.float32(self.p_op),
             p_get=jnp.float32(self.p_get),
+            p_put=jnp.float32(self.p_put),
             p_retry=jnp.float32(self.p_retry),
             bug_skip_dedup=jnp.bool_(self.bug_skip_dedup),
             bug_apply_uncommitted=jnp.bool_(self.bug_apply_uncommitted),
@@ -107,6 +116,7 @@ class KvKnobs(NamedTuple):
 
     p_op: jax.Array
     p_get: jax.Array
+    p_put: jax.Array
     p_retry: jax.Array
     bug_skip_dedup: jax.Array
     bug_apply_uncommitted: jax.Array
@@ -121,20 +131,25 @@ class KvState(NamedTuple):
     clerk_seq: jax.Array     # i32 last started seq (0 = none yet)
     clerk_out: jax.Array     # bool: op clerk_seq is still uncommitted
     clerk_key: jax.Array     # i32 key of the outstanding op
-    clerk_kind: jax.Array    # i32 op kind: _APPEND or _GET
+    clerk_kind: jax.Array    # i32 op kind: _APPEND, _GET, or _PUT
     clerk_acked: jax.Array   # i32 highest committed (acked) seq
     # --- reads-linearizability oracle state ---
-    # Appends are the only mutations and the log totally orders them, so key
-    # k's state IS its committed-append count; a Get is linearizable iff its
-    # observed count lies in [truth at invoke, truth at return]. This interval
-    # check is exact for this datatype: for non-overlapping reads r1 < r2,
-    # obs(r2) >= truth(invoke r2) >= truth(return r1) >= obs(r1), i.e.
-    # monotonicity follows. It is the batched, closed-form analogue of the
-    # Wing-Gong checker the C++ backend runs (cpp/kvraft/linearize.h; the
-    # reference leaves those tests commented out, kvraft/tests.rs:386-390).
-    truth_count: jax.Array   # i32 [NK] committed appends per key (shadow-derived,
-    #                          DEDUPED: clerk retries commit duplicate entries;
-    #                          state counts each op once, so truth must too)
+    # The log totally orders mutations (Appends and Puts), so key k's
+    # observable state IS its committed MUTATION VERSION — the count of
+    # mutations applied, which is monotone even though a Put resets the
+    # value string. A Get is linearizable iff its observed version lies in
+    # [truth at invoke, truth at return]. This interval check is exact for
+    # this datatype: for non-overlapping reads r1 < r2, obs(r2) >=
+    # truth(invoke r2) >= truth(return r1) >= obs(r1), i.e. monotonicity
+    # follows. It is the batched, closed-form analogue of the Wing-Gong
+    # checker the C++ backend runs (cpp/kvraft/linearize.h; the reference
+    # leaves those tests commented out, kvraft/tests.rs:386-390); the bridge
+    # translates a version back to the concrete value string (last Put's
+    # token + Appends after it) when exporting histories.
+    truth_count: jax.Array   # i32 [NK] committed mutations per key
+    #                          (shadow-derived, DEDUPED: clerk retries commit
+    #                          duplicate entries; state counts each op once,
+    #                          so truth must too)
     truth_max_seq: jax.Array  # i32 [NC] highest seq seen in the shadow per client
     clerk_get_lo: jax.Array  # i32 [NC] truth_count[key] captured at invoke
     clerk_get_obs: jax.Array  # i32 [NC] observed count; -1 = no reply yet
@@ -150,8 +165,8 @@ class KvState(NamedTuple):
     applied: jax.Array       # i32 [N] apply cursor, absolute (>= base)
     last_seq: jax.Array      # i32 [N, NC] dup table: last applied seq
     apply_count: jax.Array   # i32 [N, NC] ops applied (must equal last_seq)
-    key_hash: jax.Array      # i32 [N, NK] rolling hash of applied appends
-    key_count: jax.Array     # i32 [N, NK] applied appends per key
+    key_hash: jax.Array      # i32 [N, NK] rolling hash of applied mutations
+    key_count: jax.Array     # i32 [N, NK] applied mutation version per key
     snap_last_seq: jax.Array     # i32 [N, NC] (persistent)
     snap_apply_count: jax.Array  # i32 [N, NC] (persistent)
     snap_key_hash: jax.Array     # i32 [N, NK] (persistent)
@@ -167,13 +182,13 @@ def _check_kv_cfg(cfg: SimConfig) -> None:
 
 
 def _pack(cfg: KvConfig, client, seq, key, kind):
-    return (((client * _SEQ_LIM + seq) * cfg.n_keys + key) * 2 + kind) + 1
+    return (((client * _SEQ_LIM + seq) * cfg.n_keys + key) * 4 + kind) + 1
 
 
 def _unpack(cfg: KvConfig, val):
     v = val - 1
-    kind = v % 2
-    v = v // 2
+    kind = v % 4
+    v = v // 4
     key = v % cfg.n_keys
     cs = v // cfg.n_keys
     return cs // _SEQ_LIM, cs % _SEQ_LIM, key, kind  # client, seq, key, kind
@@ -255,7 +270,7 @@ def kv_step(
     )  # [cap]: an earlier new lane holds the same op
     sh_first = sh_new & (sh_seq > prev_max_at) & ~dup_earlier
     truth_count = ks.truth_count + jnp.sum(
-        (sh_first & (sh_kind == _APPEND))[None, :]
+        (sh_first & (sh_kind != _GET))[None, :]  # Appends AND Puts mutate
         & (sh_key[None, :] == jnp.arange(nk, dtype=I32)[:, None]),
         axis=1, dtype=I32,
     )
@@ -341,8 +356,10 @@ def kv_step(
             ~kkn.bug_stale_read & jnp.any(is_op & ~dup & (seq > prev + 1)),
             VIOLATION_EXACTLY_ONCE, 0)
         do = is_op & (kkn.bug_skip_dedup | ~dup)
-        # Gets read; only Appends mutate the key state.
-        mut = do & (kind == _APPEND)
+        # Gets read; Appends and Puts mutate the key state. The packed val
+        # rides into the hash, so a put and an append at the same version
+        # hash differently (kind is in the low bits).
+        mut = do & (kind != _GET)
         k_oh = (k_lane == k[:, None]) & mut[:, None]  # [n, nk]
         key_hash = jnp.where(k_oh, key_hash * 1000003 + val[:, None], key_hash)
         key_count = jnp.where(k_oh, key_count + 1, key_count)
@@ -350,8 +367,8 @@ def kv_step(
         last_seq = jnp.where(
             cl_oh & is_op[:, None], jnp.maximum(prev, seq)[:, None], last_seq
         )
-        # Get observation: the value a Get returns is the key's applied-append
-        # count at its log position — a pure function of the log prefix, so
+        # Get observation: the value a Get returns is the key's mutation
+        # version at its log position — a pure function of the log prefix, so
         # the first node to apply it yields the canonical reply (agreement
         # between apply machines is checked separately by KV_DIVERGE).
         obs_node = jnp.sum(
@@ -434,11 +451,14 @@ def kv_step(
         jax.random.randint(kk[1], (nc,), 0, kcfg.n_keys, dtype=I32),
         ks.clerk_key,
     )
+    u_kind = jax.random.uniform(jax.random.fold_in(key, _S_CLERK_KIND), (nc,))
     clerk_kind = jnp.where(
         start,
-        jax.random.bernoulli(
-            jax.random.fold_in(key, _S_CLERK_KIND), kkn.p_get, (nc,)
-        ).astype(I32),
+        jnp.where(
+            u_kind < kkn.p_get,
+            _GET,
+            jnp.where(u_kind < kkn.p_get + kkn.p_put, _PUT, _APPEND),
+        ),
         ks.clerk_kind,
     )
     # a fresh Get captures its invoke-time truth; its observation resets

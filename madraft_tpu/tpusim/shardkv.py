@@ -48,7 +48,7 @@ Oracles (all on-device reductions, sticky violation bits):
   table, so migrated-away retries double-apply).
 
 Entry packing (i32 log values, low 3 bits = kind):
-  APPEND/GET ((client*SEQ_LIM + seq)*NS + shard)*8 + {0,4} + 1
+  APPEND/GET/PUT ((client*SEQ_LIM + seq)*NS + shard)*8 + {0,4,5} + 1
   CONFIG     (cfg_idx)*8 + 1 + 1
   INSTALL    (cfg_idx*NS + shard)*8 + 2 + 1
   DELETE     (cfg_idx*NS + shard)*8 + 3 + 1
@@ -89,8 +89,10 @@ _SEQ_LIM = 1 << 13
 _BIG = 1 << 30
 
 # Entry kinds (3 bits; GET rides the log like the reference's committed-read
-# path, /root/reference/src/shardkv/msg.rs:10-15 Reply::Get).
-_APPEND, _CONFIG, _INSTALL, _DELETE, _GET = 0, 1, 2, 3, 4
+# path, /root/reference/src/shardkv/msg.rs:10-15 Reply::Get; PUT completes
+# the reference op set — it mutates like an Append, and a key's observable
+# state is its monotone MUTATION VERSION, kv.py's model).
+_APPEND, _CONFIG, _INSTALL, _DELETE, _GET, _PUT = 0, 1, 2, 3, 4, 5
 # Shard phases.
 ABSENT, OWNED, PULLING, FROZEN = 0, 1, 2, 3
 
@@ -113,7 +115,11 @@ class ShardKvConfig:
     n_configs: int = 6          # length of the pre-drawn config schedule
     cfg_interval: int = 60      # mean ticks between config activations
     p_op: float = 0.4           # idle clerk starts a fresh op
-    p_get: float = 0.3          # a fresh op is a Get (else an Append)
+    p_get: float = 0.3          # a fresh op is a Get with this probability,
+    p_put: float = 0.0          # a Put with this one (one uniform draw, as
+    #                             kv.py), an Append otherwise — the full
+    #                             reference op set (shardkv Op::{Get,Put,
+    #                             Append}, msg.rs)
     p_retry: float = 0.5        # pending clerk re-submits this tick
     p_cfg_learn: float = 0.3    # clerk/leader learns a newer config this tick
     p_pull: float = 0.4         # leader (re)sends a pull for a PULLING shard
@@ -220,11 +226,12 @@ class ShardKvState(NamedTuple):
     clerk_seq: jax.Array
     clerk_out: jax.Array          # bool
     clerk_shard: jax.Array
-    clerk_kind: jax.Array         # i32: _APPEND or _GET
+    clerk_kind: jax.Array         # i32: _APPEND, _GET, or _PUT
     clerk_cfg: jax.Array          # clerk's believed config index
     clerk_acked: jax.Array
     # --- reads-linearizability oracle state (kv.py's design per shard:
-    # a shard's state IS its accepted-append count, so a Get is linearizable
+    # a shard's state IS its accepted-mutation VERSION (appends + puts;
+    # monotone, kv.py's model), so a Get is linearizable
     # iff its observed count lies in [truth at invoke, truth at return]) ---
     clerk_get_lo: jax.Array       # i32 [NC] truth_count[shard] at invoke
     clerk_get_obs: jax.Array      # i32 [NC] observed count; -1 = no reply yet
@@ -516,17 +523,18 @@ def shardkv_step(
         sh_oh = sh_lane[None, None, :] == shard[..., None]          # [G,N,NS]
         cl_oh = cl_lane[None, None, :] == client[..., None]          # [G,N,NC]
 
-        # APPEND/GET: accept iff the shard is OWNED here and the seq is
-        # fresh; only Appends mutate, both update the dup table.
+        # APPEND/PUT/GET: accept iff the shard is OWNED here and the seq is
+        # fresh; mutations (Append/Put) bump the version, all update the
+        # dup table.
         cur_phase = jnp.sum(jnp.where(sh_oh, phase, 0), axis=-1)
         owned = cur_phase == OWNED
         prev_seq = jnp.sum(
             jnp.where(sh_oh[..., None] & cl_oh[..., None, :], last_seq, 0),
             axis=(-2, -1),
         )
-        is_rw = can & ((kind == _APPEND) | (kind == _GET))
+        is_rw = can & ((kind == _APPEND) | (kind == _PUT) | (kind == _GET))
         acc_rw = is_rw & owned & (seq > prev_seq)
-        acc = acc_rw & (kind == _APPEND)
+        acc = acc_rw & (kind != _GET)  # Appends AND Puts mutate
         upd = sh_oh & acc[..., None]
         key_hash = jnp.where(upd, key_hash * 1000003 + val[..., None], key_hash)
         key_count = jnp.where(upd, key_count + 1, key_count)
@@ -534,8 +542,8 @@ def shardkv_step(
             sh_oh[..., None] & acc_rw[..., None, None] & cl_oh[..., None, :],
             jnp.maximum(last_seq, seq[..., None, None]), last_seq,
         )
-        # Get observation: the value a Get returns is the shard's accepted-
-        # append count at its log position (a pure function of the committed
+        # Get observation: the value a Get returns is the shard's
+        # mutation version at its log position (a pure function of the committed
         # prefix; the first node to apply it yields the canonical reply, and
         # inter-node agreement is covered by the walker-divergence oracle).
         cur_count = jnp.sum(jnp.where(sh_oh, key_count, 0), axis=-1)  # [G,N]
@@ -663,9 +671,9 @@ def shardkv_step(
             jnp.where(sh_oh[..., None] & cl_oh[:, None, :], w_last_seq, 0),
             axis=(-2, -1),
         )
-        is_rw = canw & ((kind == _APPEND) | (kind == _GET))
+        is_rw = canw & ((kind == _APPEND) | (kind == _PUT) | (kind == _GET))
         acc_rw = is_rw & (cur_phase == OWNED) & (seq > prev_seq)
-        acc = acc_rw & (kind == _APPEND)
+        acc = acc_rw & (kind != _GET)  # Appends AND Puts mutate
         upd = sh_oh & acc[:, None]
         w_hash = jnp.where(upd, w_hash * 1000003 + val[:, None], w_hash)
         w_count = jnp.where(upd, w_count + 1, w_count)
@@ -942,8 +950,8 @@ def shardkv_step(
         st.clerk_out & (w_clerk_acked >= st.clerk_seq)
         & (~is_get_c | (clerk_get_obs >= 0))
     )
-    # Reads linearizability across migration: the observed accepted-append
-    # count must lie in the op's [invoke, return] truth window (exact for
+    # Reads linearizability across migration: the observed mutation
+    # version must lie in the op's [invoke, return] truth window (exact for
     # count registers — kv.py KvState docstring; the freeze/install protocol
     # makes the count well-defined across the shard's migration chain).
     done_get = newly & is_get_c
@@ -971,10 +979,13 @@ def shardkv_step(
         start, jax.random.randint(kc[2], (nc,), 0, ns, dtype=I32),
         st.clerk_shard,
     )
+    u_kind = jax.random.uniform(kc[5], (nc,))
     clerk_kind = jnp.where(
         start,
         jnp.where(
-            jax.random.bernoulli(kc[5], kcfg.p_get, (nc,)), _GET, _APPEND
+            u_kind < kcfg.p_get,
+            _GET,
+            jnp.where(u_kind < kcfg.p_get + kcfg.p_put, _PUT, _APPEND),
         ),
         st.clerk_kind,
     )

@@ -245,3 +245,30 @@ def test_bridge_replays_planted_bug_classes():
                 f"{bug}: clean replay of the same schedule violated: {clean}"
             )
         assert matched > 0, f"{bug}: no C++ replay reproduced the class"
+
+
+def test_kv_put_histories_cross_validated_by_wing_gong():
+    """Put joins the exported op set: a clean Get/Put/Append history must
+    pass the C++ Wing-Gong checker with values translated through the
+    mutation-version model (a version maps to last-Put-token + Appends
+    after it — cpp/kvraft/kv.h apply semantics)."""
+    from madraft_tpu.tpusim.kv import KvConfig, kv_fuzz
+
+    _ensure_lincheck_binary()
+    cfg = SimConfig(
+        n_nodes=5, p_client_cmd=0.0, compact_at_commit=False, log_cap=64,
+        compact_every=16, loss_prob=0.1, p_crash=0.01, p_restart=0.2,
+        max_dead=2,
+    )
+    kcfg = KvConfig(p_get=0.35, p_put=0.3, p_retry=0.6)
+    n_ticks = 600
+    rep = kv_fuzz(cfg, kcfg, seed=23, n_clusters=8, n_ticks=n_ticks)
+    assert rep.n_violating == 0
+    checked = puts = 0
+    for cid in (0, 5):
+        lines, viol = bridge.extract_kv_history(cfg, kcfg, 23, cid, n_ticks)
+        assert viol == 0
+        puts += sum(" put " in ln for ln in lines)
+        assert bridge.check_history_on_simcore(lines)
+        checked += 1
+    assert checked == 2 and puts > 0, "put ops must appear in the export"

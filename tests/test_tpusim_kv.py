@@ -131,3 +131,26 @@ def jnp_seed(s):
     import jax.numpy as jnp
 
     return jnp.asarray(s, jnp.uint32)
+
+
+def test_kv_with_puts_clean():
+    """The full reference op set Op::{Get,Put,Append} (msg.rs:3-8) under the
+    fault storm: Puts reset values but the mutation-version model keeps every
+    oracle exact — zero violations, and all three kinds flow."""
+    rep = kv_fuzz(BASE, KV.replace(p_get=0.3, p_put=0.3), seed=21,
+                  n_clusters=96, n_ticks=320)
+    assert rep.n_violating == 0, (
+        f"violations: {rep.violations[rep.violating_clusters()[:8]]}"
+    )
+    assert rep.acked_ops.sum() > 96 * 5
+    assert rep.acked_gets.sum() > 96
+
+
+def test_kv_stale_read_oracle_fires_with_puts():
+    """The read-from-follower bug must stay visible when Puts are in the
+    mix (a stale version below the invoke-time truth)."""
+    rep = kv_fuzz(BASE, KV.replace(bug_stale_read=True, p_get=0.4, p_put=0.3),
+                  seed=7, n_clusters=64, n_ticks=320)
+    assert rep.n_violating > 0, "stale-read bug with puts escaped the oracle"
+    bits = rep.violations[rep.violating_clusters()]
+    assert (bits & VIOLATION_STALE_READ).any()

@@ -19,7 +19,7 @@ def test_kv_pack_roundtrip_and_uniqueness():
     for client in range(cfg.n_clients):
         for seq in (0, 1, 2, kvm._SEQ_LIM - 1):
             for key in range(cfg.n_keys):
-                for kind in (kvm._APPEND, kvm._GET):
+                for kind in (kvm._APPEND, kvm._GET, kvm._PUT):
                     v = int(kvm._pack(cfg, client, seq, key, kind))
                     assert v != 0 and v != NOOP_CMD
                     assert v not in seen
@@ -32,7 +32,8 @@ def test_kv_pack_roundtrip_and_uniqueness():
 
 def test_kv_pack_fits_i32_at_limits():
     cfg = kvm.KvConfig(n_clients=8, n_keys=8)
-    v = kvm._pack(cfg, cfg.n_clients - 1, kvm._SEQ_LIM - 1, cfg.n_keys - 1, 1)
+    v = kvm._pack(cfg, cfg.n_clients - 1, kvm._SEQ_LIM - 1, cfg.n_keys - 1,
+                  kvm._PUT)  # the largest kind
     assert 0 < int(v) < 2**31
 
 
@@ -42,7 +43,7 @@ def test_shardkv_op_pack_roundtrip():
     for client in range(cfg.n_clients):
         for seq in (0, 1, skvm._SEQ_LIM - 1):
             for shard in range(cfg.n_shards):
-                for kind in (skvm._APPEND, skvm._GET):
+                for kind in (skvm._APPEND, skvm._GET, skvm._PUT):
                     v = int(skvm._pack_op(cfg, client, seq, shard, kind))
                     assert v != 0 and v not in seen
                     seen.add(v)
