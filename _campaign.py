@@ -70,6 +70,7 @@ kcfg_base = SimConfig(n_nodes=5, p_client_cmd=0.0, compact_at_commit=False,
 for kv, ticks in [
     (KvConfig(apply_max=1, p_retry=1.0, p_get=0.5), 768),
     (KvConfig(n_clients=8, n_keys=2, p_op=0.8, p_retry=0.9, p_get=0.4), 768),
+    (KvConfig(p_op=0.6, p_retry=0.8, p_get=0.3, p_put=0.4), 768),
 ]:
     rr = kv_fuzz(kcfg_base, kv, seed=88, n_clusters=32, n_ticks=ticks)
     check(f"kv nc={kv.n_clients} am={kv.apply_max}", rr.n_violating == 0,
@@ -80,7 +81,7 @@ for g, ns, nodes in [(2, 4, 3), (4, 10, 3), (3, 10, 5)]:
     raft = SimConfig(n_nodes=nodes, p_client_cmd=0.0, compact_at_commit=False,
                      log_cap=64, compact_every=16, loss_prob=0.1,
                      p_crash=0.01, p_restart=0.2, max_dead=1)
-    sk = ShardKvConfig(n_groups=g, n_shards=ns, n_configs=10, cfg_interval=60, p_get=0.3)
+    sk = ShardKvConfig(n_groups=g, n_shards=ns, n_configs=10, cfg_interval=60, p_get=0.3, p_put=0.2)
     rr = shardkv_fuzz(raft, sk, seed=88, n_clusters=10, n_ticks=1100)
     check(f"shardkv g={g} ns={ns} n={nodes}", rr.n_violating == 0,
           f"viol={rr.n_violating} cfg_min={rr.final_cfg.min()} inst={rr.installs.sum()} del={rr.deletes.sum()}")

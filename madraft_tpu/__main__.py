@@ -40,6 +40,8 @@ def _sim_config(args):
         cfg = _storm(cfg)
     if args.majority_override:
         cfg = cfg.replace(majority_override=args.majority_override)
+    if args.bug:
+        cfg = cfg.replace(bug=args.bug)
     return cfg
 
 
@@ -130,7 +132,8 @@ def cmd_kv_fuzz(args):
     mesh = _mesh(args)
 
     def run():
-        return kv_fuzz(cfg, KvConfig(p_get=args.p_get), seed=args.seed,
+        return kv_fuzz(cfg, KvConfig(p_get=args.p_get, p_put=args.p_put),
+                       seed=args.seed,
                        n_clusters=args.clusters, n_ticks=args.ticks, mesh=mesh)
 
     return _finish_fuzz(args, run)
@@ -146,14 +149,16 @@ def cmd_shardkv_fuzz(args):
         loss_prob=0.1 if args.storm else 0.05,
         p_crash=0.01 if args.storm else 0.0,
         p_restart=0.2, max_dead=1 if args.storm else 0,
+        bug=args.bug,
     )
 
     mesh = _mesh(args)
 
     def run():
-        return shardkv_fuzz(cfg, ShardKvConfig(p_get=args.p_get),
-                            seed=args.seed, n_clusters=args.clusters,
-                            n_ticks=args.ticks, mesh=mesh)
+        return shardkv_fuzz(
+            cfg, ShardKvConfig(p_get=args.p_get, p_put=args.p_put),
+            seed=args.seed, n_clusters=args.clusters,
+            n_ticks=args.ticks, mesh=mesh)
 
     return _finish_fuzz(args, run)
 
@@ -208,6 +213,10 @@ def main(argv=None) -> int:
                         help="full fault storm (loss+crash+partitions)")
         sp.add_argument("--majority-override", type=int, default=0,
                         help="deliberately broken quorum (oracle demo)")
+        sp.add_argument("--bug", default="",
+                        help="raft-layer planted bug (config.py RAFT_BUGS: "
+                             "commit_any_term | grant_any_vote | "
+                             "forget_voted_for | no_truncate)")
 
     def fuzz_common(sp, clusters):
         common(sp, clusters)
@@ -227,11 +236,13 @@ def main(argv=None) -> int:
     sp = sub.add_parser("kv-fuzz", help="KV service fuzz (Lab 3)")
     fuzz_common(sp, 512)
     sp.add_argument("--p-get", type=float, default=0.3)
+    sp.add_argument("--p-put", type=float, default=0.2)
     sp.set_defaults(fn=cmd_kv_fuzz)
 
     sp = sub.add_parser("shardkv-fuzz", help="multi-group sharded KV (Lab 4B)")
     fuzz_common(sp, 64)
     sp.add_argument("--p-get", type=float, default=0.3)
+    sp.add_argument("--p-put", type=float, default=0.2)
     sp.set_defaults(fn=cmd_shardkv_fuzz)
 
     sp = sub.add_parser("replay", help="re-run ONE cluster exactly")
