@@ -9,6 +9,15 @@
 
 namespace madtpu_tools {
 
+// Single source of the raft-layer planted-bug whitelist on the C++ side
+// (mirrors config.py RAFT_BUGS; the bug() sites live in raftcore/raft.cpp).
+// Both schedule parsers reject unknown names — a silently-skipped bug would
+// make a clean replay read as "TPU false positive".
+inline bool is_known_raft_bug(const std::string& name) {
+  return name == "commit_any_term" || name == "grant_any_vote" ||
+         name == "forget_voted_for" || name == "no_truncate";
+}
+
 struct EnvGuard {
   const char* name;
   std::string saved;

@@ -59,13 +59,7 @@ inline bool parse_schedule(FILE* f, Schedule* out) {
     } else if (!std::strcmp(kw, "bug")) {
       char name[64] = {0};
       if (std::sscanf(line, "%*s %63s", name) == 1) out->bug = name;
-      // Reject names raftcore doesn't implement (keep in sync with
-      // raft.cpp's bug() sites / config.py RAFT_BUGS): a silently-ignored
-      // bug would make a clean replay read as "TPU false positive" when
-      // the bug was simply never injected.
-      if (out->bug != "commit_any_term" && out->bug != "grant_any_vote" &&
-          out->bug != "forget_voted_for" && out->bug != "no_truncate")
-        return false;
+      if (!madtpu_tools::is_known_raft_bug(out->bug)) return false;
     } else if (!std::strcmp(kw, "seed")) {
       std::sscanf(line, "%*s %" SCNu64, &out->seed);
     } else if (!std::strcmp(kw, "ev")) {

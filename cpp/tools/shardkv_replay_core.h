@@ -68,15 +68,16 @@ inline bool parse_schedule(FILE* f, Schedule* out) {
     } else if (!std::strcmp(kw, "bug")) {
       char b[64];
       if (std::sscanf(line, "%*s %63s", b) == 1) out->bug = b;
+      // same silent-skip guard as the raft bug below: an unknown service
+      // bug name would set MADTPU_SHARDKV_BUG to something shardkv.h's
+      // bug_mode() never matches and replay the correct service
+      if (out->bug != "none" && out->bug != "drop_dup_table" &&
+          out->bug != "serve_frozen")
+        return false;
     } else if (!std::strcmp(kw, "raft_bug")) {
       char b[64] = {0};
       if (std::sscanf(line, "%*s %63s", b) == 1) out->raft_bug = b;
-      // same guard as replay_core.h: a silently-ignored bug name would make
-      // a clean replay read as "TPU false positive"
-      if (out->raft_bug != "commit_any_term" &&
-          out->raft_bug != "grant_any_vote" &&
-          out->raft_bug != "forget_voted_for" && out->raft_bug != "no_truncate")
-        return false;
+      if (!madtpu_tools::is_known_raft_bug(out->raft_bug)) return false;
     } else if (!std::strcmp(kw, "cfg")) {
       CfgEvent ev;
       int consumed = 0;

@@ -92,6 +92,23 @@ class KvConfig:
     #                               the classic read-from-follower bug the
     #                               linearizability oracle must catch
 
+    def __post_init__(self):
+        if self.p_get + self.p_put > 1.0:
+            raise ValueError(
+                f"p_get ({self.p_get}) + p_put ({self.p_put}) must stay <= 1 "
+                "(one uniform draw splits Get/Put/Append; an over-unity pair "
+                "would silently starve Appends)"
+            )
+        # every packed op must stay below NOOP_CMD (the leader no-op
+        # sentinel) or a real client op would be skipped as a no-op forever
+        # (silent clerk livelock) — and below i32
+        top = _pack(self, self.n_clients - 1, _SEQ_LIM - 1, self.n_keys - 1, 3)
+        if top >= NOOP_CMD:
+            raise ValueError(
+                f"n_clients ({self.n_clients}) x n_keys ({self.n_keys}) "
+                f"overflow the op packing (max {top} >= NOOP_CMD {NOOP_CMD})"
+            )
+
     def replace(self, **kw) -> "KvConfig":
         return dataclasses.replace(self, **kw)
 

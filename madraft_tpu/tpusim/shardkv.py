@@ -72,7 +72,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from madraft_tpu.tpusim.config import LEADER, SimConfig
+from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
 from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
 from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 
@@ -141,6 +141,21 @@ class ShardKvConfig:
     #                                  (a FROZEN surrendered copy, or nothing
     #                                  after GC) — the sharded stale-read bug
     #                                  the interval oracle must catch
+
+    def __post_init__(self):
+        if self.p_get + self.p_put > 1.0:
+            raise ValueError(
+                f"p_get ({self.p_get}) + p_put ({self.p_put}) must stay <= 1"
+            )
+        # packed ops must stay below NOOP_CMD (which decodes as the unused
+        # kind 7) so no client op ever aliases the no-op or overflows i32
+        top = _pack_op(self, self.n_clients - 1, _SEQ_LIM - 1,
+                       self.n_shards - 1, 7)
+        if top >= NOOP_CMD:
+            raise ValueError(
+                f"n_clients ({self.n_clients}) x n_shards ({self.n_shards}) "
+                f"overflow the op packing (max {top} >= NOOP_CMD {NOOP_CMD})"
+            )
 
     def replace(self, **kw) -> "ShardKvConfig":
         return dataclasses.replace(self, **kw)
