@@ -74,15 +74,25 @@ inline size_t key2shard(const std::string& key) {
 //   MADTPU_SHARDKV_BUG=serve_frozen    — a leader skips the ownership check
 //                                        for reads and serves Gets from
 //                                        whatever local copy exists
+// Name -> mode mapping, shared with the schedule parser's whitelist
+// (cpp/tools/shardkv_replay_core.h): a name this function does not know is
+// NOT a valid schedule bug, so the two can never drift apart.
+inline int bug_mode_of(const char* name) {
+  if (!name) return 0;
+  if (!std::strcmp(name, "drop_dup_table")) return 1;
+  if (!std::strcmp(name, "serve_frozen")) return 2;
+  return 0;
+}
+
+inline bool is_known_service_bug(const std::string& name) {
+  return name == "none" || bug_mode_of(name.c_str()) != 0;
+}
+
 inline int bug_mode() {
   // read per call, NOT cached statically: the in-process C API
   // (cpp/tools/capi.cpp) runs replays with different bug modes in one
   // process; this is a cold path (client ops + installs)
-  const char* e = std::getenv("MADTPU_SHARDKV_BUG");
-  if (!e) return 0;
-  if (!std::strcmp(e, "drop_dup_table")) return 1;
-  if (!std::strcmp(e, "serve_frozen")) return 2;
-  return 0;
+  return bug_mode_of(std::getenv("MADTPU_SHARDKV_BUG"));
 }
 
 // msg.rs:3-8

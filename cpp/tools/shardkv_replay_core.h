@@ -70,10 +70,9 @@ inline bool parse_schedule(FILE* f, Schedule* out) {
       if (std::sscanf(line, "%*s %63s", b) == 1) out->bug = b;
       // same silent-skip guard as the raft bug below: an unknown service
       // bug name would set MADTPU_SHARDKV_BUG to something shardkv.h's
-      // bug_mode() never matches and replay the correct service
-      if (out->bug != "none" && out->bug != "drop_dup_table" &&
-          out->bug != "serve_frozen")
-        return false;
+      // bug_mode() never matches and replay the correct service — the
+      // whitelist IS bug_mode_of's name table, so they cannot drift
+      if (!shardkv::is_known_service_bug(out->bug)) return false;
     } else if (!std::strcmp(kw, "raft_bug")) {
       char b[64] = {0};
       if (std::sscanf(line, "%*s %63s", b) == 1) out->raft_bug = b;

@@ -3,6 +3,7 @@
 
     python -m madraft_tpu fuzz        --clusters 4096 --ticks 1024 [--storm]
     python -m madraft_tpu kv-fuzz     --clusters 512  --ticks 512
+    python -m madraft_tpu ctrler-fuzz --clusters 512  --ticks 512
     python -m madraft_tpu shardkv-fuzz --clusters 64  --ticks 640
     python -m madraft_tpu replay      --seed S --cluster C --ticks T [--storm]
     python -m madraft_tpu bridge      --seed S --cluster C --ticks T [--storm]
@@ -139,6 +140,25 @@ def cmd_kv_fuzz(args):
     return _finish_fuzz(args, run)
 
 
+def cmd_ctrler_fuzz(args):
+    from madraft_tpu.tpusim.ctrler import CtrlerConfig, ctrler_fuzz
+
+    cfg = _sim_config(args).replace(
+        p_client_cmd=0.0, compact_at_commit=False, log_cap=32, compact_every=8
+    )
+
+    mesh = _mesh(args)
+
+    def run():
+        return ctrler_fuzz(
+            cfg,
+            CtrlerConfig(p_query=args.p_query, p_move=args.p_move),
+            seed=args.seed, n_clusters=args.clusters, n_ticks=args.ticks,
+            mesh=mesh)
+
+    return _finish_fuzz(args, run)
+
+
 def cmd_shardkv_fuzz(args):
     from madraft_tpu.tpusim import SimConfig
     from madraft_tpu.tpusim.shardkv import ShardKvConfig, shardkv_fuzz
@@ -238,6 +258,14 @@ def main(argv=None) -> int:
     sp.add_argument("--p-get", type=float, default=0.3)
     sp.add_argument("--p-put", type=float, default=0.2)
     sp.set_defaults(fn=cmd_kv_fuzz)
+
+    sp = sub.add_parser(
+        "ctrler-fuzz", help="shard-controller config service (Lab 4A)"
+    )
+    fuzz_common(sp, 512)
+    sp.add_argument("--p-query", type=float, default=0.3)
+    sp.add_argument("--p-move", type=float, default=0.1)
+    sp.set_defaults(fn=cmd_ctrler_fuzz)
 
     sp = sub.add_parser("shardkv-fuzz", help="multi-group sharded KV (Lab 4B)")
     fuzz_common(sp, 64)

@@ -29,6 +29,21 @@ from madraft_tpu.tpusim.kv import (
     make_kv_fuzz_fn,
 )
 
+from madraft_tpu.tpusim.ctrler import (
+    VIOLATION_CTRL_BALANCE,
+    VIOLATION_CTRL_DIVERGE,
+    VIOLATION_CTRL_MINIMAL,
+    VIOLATION_CTRL_QUERY,
+    CtrlerConfig,
+    CtrlerFuzzReport,
+    CtrlerState,
+    ctrler_fuzz,
+    ctrler_replay_cluster,
+    ctrler_report,
+    ctrler_step,
+    init_ctrler_cluster,
+    make_ctrler_fuzz_fn,
+)
 from madraft_tpu.tpusim.shardkv import (
     VIOLATION_SHARD_DIVERGE,
     VIOLATION_SHARD_OWNERSHIP,
@@ -45,6 +60,19 @@ from madraft_tpu.tpusim.shardkv import (
 
 __all__ = [
     "SimConfig",
+    "CtrlerConfig",
+    "CtrlerFuzzReport",
+    "CtrlerState",
+    "ctrler_fuzz",
+    "ctrler_replay_cluster",
+    "ctrler_report",
+    "ctrler_step",
+    "init_ctrler_cluster",
+    "make_ctrler_fuzz_fn",
+    "VIOLATION_CTRL_BALANCE",
+    "VIOLATION_CTRL_DIVERGE",
+    "VIOLATION_CTRL_MINIMAL",
+    "VIOLATION_CTRL_QUERY",
     "ShardKvConfig",
     "ShardKvFuzzReport",
     "ShardKvState",

@@ -1,0 +1,835 @@
+"""Batched shard-controller fuzzing on top of the Raft tick (Lab 4A on TPU).
+
+The reference's shard_ctrler (SURVEY.md §2 C8, /root/reference/src/shard_ctrler/)
+is a replicated config service: ``Config{num, shards: [Gid; 10], groups}`` with
+ops ``Join/Leave/Move/Query`` (msg.rs:20-37), where Join/Leave must rebalance
+shards over the member groups *balanced* (max-min <= 1, tester.rs:134-150),
+*minimally* (tests.rs:122-163,239-278), and — the part the README warns about
+(README.md:79, "never iterate a HashMap") — *deterministically across
+replicas*. ``shardkv.py`` deliberately models the controller as a pre-drawn
+schedule tensor (its §4B focus is the migration protocol); THIS module is the
+4A service itself as a replicated state machine fuzzed on-device:
+
+- One raft cluster per universe (``step_cluster`` under vmap); the state
+  machine is the config service: a member mask over ``n_gids`` possible
+  groups, the shard->group owner map, and the full config HISTORY (one hash
+  per config num, the tensor analogue of the reference's ``Vec<Config>``).
+- Clerks are tensors exactly as in kv.py: one outstanding (client, seq, op)
+  each, retried to random nodes until committed — the ClerkCore contract
+  (shard_ctrler/client.rs reuses kvraft's ClerkCore, client.rs:2).
+- Join(gid)/Leave(gid) apply the canonical rebalance below; Move(shard, gid)
+  applies verbatim (server applies Move without rebalancing — the reference's
+  Move semantics; a Move to a non-member gid is REJECTED with no new config,
+  the error-surfacing behavior the C++ backend adopted in round 3); Query(num)
+  is a committed read returning the config at ``min(num, latest)`` — num
+  beyond the history means "latest", the u64::MAX convention (client.rs:17).
+
+Canonical rebalance (the deterministic spec both backends implement; the
+reference leaves ShardInfo::apply as a todo!() stub, server.rs:17):
+  1. invalidate owners that left the member set;
+  2. repeat at most N_SHARDS times: if an unowned shard exists, give the
+     lowest-numbered one to the least-loaded member (ties: lowest gid);
+     otherwise if max load - min load > 1, move the lowest-numbered shard of
+     the most-loaded member (ties: lowest gid) to the least-loaded (ties:
+     lowest gid). This is balanced AND minimal (unit-tested against an
+     exhaustive numpy model in tests/test_tpusim_ctrler.py).
+
+Oracles (on-device reductions, sticky violation bits):
+- CTRL_DIVERGE: an alive node whose apply cursor equals the truth walker's
+  frontier must match it bit-for-bit (member mask, owner map, config num,
+  whole config history, dup table). This is the oracle that catches the
+  classic 4A bug: replica-divergent rebalance from iteration-order-dependent
+  tie-breaking (``bug_rotate_tiebreak`` rotates the tie-break order by node
+  id — the batched analogue of iterating a HashMap).
+- CTRL_BALANCE: every Join/Leave-created config must assign each shard to a
+  member and balance loads max-min <= 1 (tester.rs:113-150's check());
+  stands down while no group is joined. ``bug_greedy_rebalance`` (dump all
+  orphans on one group) must trip it.
+- CTRL_MINIMAL: a Join/Leave transition must move exactly the minimal number
+  of shards (computed in closed form from the retained loads — see
+  ``_min_moves``); ``bug_full_reshuffle`` (recompute assignment from scratch,
+  balanced but ignoring retention) must trip it. Move-created configs are
+  exempt (the reference applies Move verbatim and only asserts minimality
+  around Join/Leave, tests.rs:122-163).
+- CTRL_QUERY: a completed Query's observation must equal the truth walker's
+  answer for the same (client, seq) — historical query_at correctness across
+  leader changes and restarts (tests.rs:64-75, 280-296: "config identical
+  across leader failover").
+
+Entry packing (i32 log values): ((client*SEQ_LIM + seq)*ARG_LIM + arg)*4
++ kind + 1, kind in {JOIN, LEAVE, MOVE, QUERY}; arg = gid, gid, shard*NG+gid,
+or config num (ARG_LIM-1 = "latest").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
+from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
+from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
+
+# Violation bits (extending config/kv/shardkv's 1..1024).
+VIOLATION_CTRL_DIVERGE = 2048   # replicas disagree at equal apply cursors
+VIOLATION_CTRL_BALANCE = 4096   # a Join/Leave config is unbalanced or orphans a shard
+VIOLATION_CTRL_MINIMAL = 8192   # a Join/Leave moved more shards than necessary
+VIOLATION_CTRL_QUERY = 16384    # a Query observed a config != the history's truth
+
+N_SHARDS = 10     # the reference's N_SHARDS (shard_ctrler/mod.rs:9)
+_SEQ_LIM = 1 << 10
+_BIG = 1 << 30
+
+# Op kinds (msg.rs:20-37).
+_JOIN, _LEAVE, _MOVE, _QUERY = 0, 1, 2, 3
+
+# PRNG site ids (disjoint from step.py 0, kv.py 8..14, shardkv.py 16..20/100+).
+_S_CLERK_START, _S_CLERK_KIND = 24, 27
+
+
+@dataclasses.dataclass(frozen=True)
+class CtrlerConfig:
+    """Knobs of the 4A fuzzing layer. ``n_gids``/``n_clients``/``n_configs``/
+    ``apply_max``/``walk_max`` shape the program; probabilities and bug modes
+    are dynamic traced scalars (one compiled program serves all)."""
+
+    n_gids: int = 5          # universe of possible group ids
+    n_clients: int = 4
+    n_configs: int = 24      # config-history capacity; mutations are rejected
+    #                          once full (deterministically, on every replica)
+    p_op: float = 0.3        # idle clerk starts a fresh op
+    p_query: float = 0.3     # fresh op is a Query with this probability,
+    p_move: float = 0.1      # a Move with this one; else Join/Leave (one draw)
+    p_retry: float = 0.5     # pending clerk re-submits this tick
+    apply_max: int = 4       # apply-machine entries per node per tick
+    walk_max: int = 6        # truth-walker entries per tick
+    # Oracle-validation bug modes (dynamic; False = correct service).
+    bug_rotate_tiebreak: bool = False   # node-id-rotated tie-breaks: replicas
+    #                                     diverge (the HashMap-iteration bug)
+    bug_greedy_rebalance: bool = False  # all orphans to one group, no
+    #                                     balancing pass (balance must fire)
+    bug_full_reshuffle: bool = False    # balanced from-scratch reassignment
+    #                                     ignoring retention (minimality fires)
+
+    def __post_init__(self):
+        if self.p_query + self.p_move > 1.0:
+            raise ValueError(
+                f"p_query ({self.p_query}) + p_move ({self.p_move}) must stay "
+                "<= 1 (one uniform draw splits Query/Move/Join-Leave)"
+            )
+        if self.n_gids < 2 or self.n_gids > N_SHARDS:
+            raise ValueError(f"n_gids must be in [2, {N_SHARDS}], got {self.n_gids}")
+        top = _pack(self, self.n_clients - 1, _SEQ_LIM - 1, self._arg_lim - 1,
+                    _QUERY)
+        if top >= NOOP_CMD:
+            raise ValueError(
+                f"n_clients ({self.n_clients}) x arg space ({self._arg_lim}) "
+                f"overflow the op packing (max {top} >= NOOP_CMD {NOOP_CMD})"
+            )
+
+    @property
+    def _arg_lim(self) -> int:
+        # gid | shard*NG+gid | config num (+1 for the "latest" sentinel)
+        return max(N_SHARDS * self.n_gids, self.n_configs + 1)
+
+    def replace(self, **kw) -> "CtrlerConfig":
+        return dataclasses.replace(self, **kw)
+
+    def knobs(self) -> "CtrlerKnobs":
+        return CtrlerKnobs(
+            p_op=jnp.float32(self.p_op),
+            p_query=jnp.float32(self.p_query),
+            p_move=jnp.float32(self.p_move),
+            p_retry=jnp.float32(self.p_retry),
+            bug_rotate_tiebreak=jnp.bool_(self.bug_rotate_tiebreak),
+            bug_greedy_rebalance=jnp.bool_(self.bug_greedy_rebalance),
+            bug_full_reshuffle=jnp.bool_(self.bug_full_reshuffle),
+        )
+
+    def static_key(self) -> "CtrlerConfig":
+        return CtrlerConfig(
+            n_gids=self.n_gids, n_clients=self.n_clients,
+            n_configs=self.n_configs, apply_max=self.apply_max,
+            walk_max=self.walk_max,
+        )
+
+
+class CtrlerKnobs(NamedTuple):
+    """Dynamic 4A-layer knobs (see CtrlerConfig)."""
+
+    p_op: jax.Array
+    p_query: jax.Array
+    p_move: jax.Array
+    p_retry: jax.Array
+    bug_rotate_tiebreak: jax.Array
+    bug_greedy_rebalance: jax.Array
+    bug_full_reshuffle: jax.Array
+
+
+def _pack(cfg: CtrlerConfig, client, seq, arg, kind):
+    return (((client * _SEQ_LIM + seq) * cfg._arg_lim + arg) * 4 + kind) + 1
+
+
+def _unpack(cfg: CtrlerConfig, val):
+    v = val - 1
+    kind = v % 4
+    v = v // 4
+    arg = v % cfg._arg_lim
+    cs = v // cfg._arg_lim
+    return cs // _SEQ_LIM, cs % _SEQ_LIM, arg, kind  # client, seq, arg, kind
+
+
+def _counts(owner, ng: int):
+    """Per-group shard loads: [.., NG] from owner [.., NS]."""
+    gid = jnp.arange(ng, dtype=I32)
+    return jnp.sum(
+        owner[..., None, :] == gid[..., :, None], axis=-1
+    ).astype(I32)
+
+
+def _rebalance(ng: int, member, owner, tie_rot, greedy, reshuffle):
+    """The canonical deterministic rebalance (module docstring), plus the two
+    shared planted-bug variants selected by traced flags. Single instance:
+    member [NG] bool, owner [NS] i32 (-1 = unowned); vmap for batching."""
+    gid = jnp.arange(ng, dtype=I32)
+    sid = jnp.arange(N_SHARDS, dtype=I32)
+    k = jnp.sum(member.astype(I32))
+    # tie-break key: lowest gid wins ties; the rotate bug permutes the order
+    # per replica, the batched analogue of HashMap iteration order
+    tkey = (gid + tie_rot) % ng
+    valid = (owner >= 0) & jnp.take(member, jnp.clip(owner, 0, ng - 1))
+    own0 = jnp.where(valid, owner, -1)
+
+    # --- canonical: NS greedy-minimal passes (each does at most one move)
+    own = own0
+    for _ in range(N_SHARDS):
+        counts = _counts(own, ng)
+        dst = jnp.argmin(jnp.where(member, counts * ng + tkey, _BIG)).astype(I32)
+        src = jnp.argmax(
+            jnp.where(member, counts * ng + (ng - 1 - tkey), -1)
+        ).astype(I32)
+        has_orphan = jnp.any(own < 0) & (k >= 1)
+        orphan_s = jnp.argmax(own < 0)
+        cmax = jnp.max(jnp.where(member, counts, -1))
+        cmin = jnp.min(jnp.where(member, counts, _BIG))
+        unbal = ~has_orphan & (k >= 1) & (cmax - cmin > 1)
+        move_s = jnp.argmax(own == src)
+        tgt_s = jnp.where(has_orphan, orphan_s, move_s)
+        own = jnp.where((sid == tgt_s) & (has_orphan | unbal), dst, own)
+
+    # --- bug_greedy_rebalance: all orphans to the single least-loaded member
+    # at entry; no balancing pass
+    c0 = _counts(own0, ng)
+    dst0 = jnp.argmin(jnp.where(member, c0 * ng + tkey, _BIG)).astype(I32)
+    own_greedy = jnp.where((own0 < 0) & (k >= 1), dst0, own0)
+
+    # --- bug_full_reshuffle: shard s -> s-th member round-robin (balanced,
+    # retention-blind)
+    order = jnp.argsort(jnp.where(member, tkey, ng + tkey))  # members first
+    own_rs = jnp.where(
+        k >= 1, jnp.take(order, sid % jnp.maximum(k, 1)).astype(I32), -1
+    )
+
+    return jnp.where(reshuffle, own_rs, jnp.where(greedy, own_greedy, own))
+
+
+def _min_moves(ng: int, member, owner):
+    """Closed-form minimal move count for a membership change: orphans (owner
+    not in the new member set) must move, and overloaded members must shed
+    down to the best-case targets (the r := NS mod k largest retained loads
+    get ceil targets). Used by the CTRL_MINIMAL oracle; stands down at k=0."""
+    k = jnp.sum(member.astype(I32))
+    valid = (owner >= 0) & jnp.take(member, jnp.clip(owner, 0, ng - 1))
+    orphans = jnp.sum((~valid).astype(I32))
+    retained = _counts(jnp.where(valid, owner, -1), ng)
+    ksafe = jnp.maximum(k, 1)
+    q, r = N_SHARDS // ksafe, N_SHARDS % ksafe
+    pos = jnp.arange(ng, dtype=I32)
+    ret_desc = jnp.sort(jnp.where(member, retained, -1))[::-1]
+    target = q + (pos < r).astype(I32)
+    shed = jnp.sum(jnp.where(pos < k, jnp.maximum(ret_desc - target, 0), 0))
+    return orphans + shed
+
+
+def _hash_config(member, owner, num):
+    """i32 hash of one config (member mask + owner map + its num)."""
+    bits = member.astype(I32) << jnp.arange(member.shape[0], dtype=I32)
+    h = jnp.sum(bits) + 1
+    for s in range(N_SHARDS):
+        h = h * 1000003 + (owner[..., s] + 2)
+    return h * 31 + num
+
+
+def _apply_entry(kcfg: CtrlerConfig, kkn: CtrlerKnobs, tie_rot,
+                 member, owner, hist, cfg_num, last_seq, val, live):
+    """Apply ONE log entry to one controller state machine instance.
+
+    ``live`` gates the whole apply (cursor < commit and node alive). Returns
+    the new state plus (accepted, q_obs, viol) — q_obs >= 0 only for an
+    accepted Query. Shared verbatim between node apply machines and the truth
+    walker so a planted transition bug affects both (letting the balance /
+    minimality oracles fire without a divergence); only ``tie_rot`` differs
+    (nodes pass node-id * bug_rotate_tiebreak, the walker passes 0).
+    """
+    ng, ncfg = kcfg.n_gids, kcfg.n_configs
+    client, seq, arg, kind = _unpack(kcfg, val)
+    client = jnp.clip(client, 0, kcfg.n_clients - 1)
+    is_op = live & (val != NOOP_CMD)
+    prev = jnp.take(last_seq, client)
+    fresh = is_op & (seq > prev)
+    cl_oh = jnp.arange(kcfg.n_clients, dtype=I32) == client
+    last_seq = jnp.where(cl_oh & is_op, jnp.maximum(prev, seq), last_seq)
+
+    room = cfg_num < ncfg - 1
+    gid_arg = jnp.clip(arg % ng, 0, ng - 1)
+    mv_shard = jnp.clip(arg // ng, 0, N_SHARDS - 1)
+    mv_gid = gid_arg
+
+    do_join = fresh & (kind == _JOIN) & room & ~jnp.take(member, gid_arg)
+    do_leave = fresh & (kind == _LEAVE) & room & jnp.take(member, gid_arg)
+    new_member = jnp.where(
+        jnp.arange(ng, dtype=I32) == gid_arg,
+        (member | do_join) & ~do_leave, member,
+    )
+    do_move = (
+        fresh & (kind == _MOVE) & room & jnp.take(member, mv_gid)
+    )
+    do_rebal = do_join | do_leave
+
+    reb = _rebalance(ng, new_member, owner, tie_rot,
+                     kkn.bug_greedy_rebalance, kkn.bug_full_reshuffle)
+    moved_owner = jnp.where(
+        jnp.arange(N_SHARDS, dtype=I32) == mv_shard, mv_gid, owner
+    )
+    new_owner = jnp.where(do_rebal, reb, jnp.where(do_move, moved_owner, owner))
+    new_cfg = do_rebal | do_move
+    cfg_num2 = jnp.where(new_cfg, cfg_num + 1, cfg_num)
+
+    # --- balance + minimality oracles on Join/Leave transitions (k >= 1)
+    k2 = jnp.sum(new_member.astype(I32))
+    cnt2 = _counts(new_owner, ng)
+    owners_ok = jnp.all(
+        (new_owner >= 0) & jnp.take(new_member, jnp.clip(new_owner, 0, ng - 1))
+    )
+    cmax = jnp.max(jnp.where(new_member, cnt2, -1))
+    cmin = jnp.min(jnp.where(new_member, cnt2, _BIG))
+    bal_bad = do_rebal & (k2 >= 1) & (~owners_ok | (cmax - cmin > 1))
+    moved = jnp.sum((new_owner != owner).astype(I32))
+    min_bad = do_rebal & (k2 >= 1) & (moved != _min_moves(ng, new_member, owner))
+    viol = jnp.where(bal_bad, VIOLATION_CTRL_BALANCE, 0) | jnp.where(
+        min_bad, VIOLATION_CTRL_MINIMAL, 0
+    )
+
+    hist = jnp.where(
+        (jnp.arange(ncfg, dtype=I32) == cfg_num2) & new_cfg,
+        _hash_config(new_member, new_owner, cfg_num2), hist,
+    )
+    member = jnp.where(new_cfg, new_member, member)
+    owner = jnp.where(new_cfg, new_owner, owner)
+
+    # Query: committed read of the config at min(num, latest); arg beyond the
+    # history (incl. the ARG_LIM-1 sentinel) means "latest" (client.rs:17).
+    # Masked to 31 bits so a legitimate observation is never negative (-1 is
+    # the "no reply yet" sentinel in clerk_q_obs / w_q_obs).
+    is_q = fresh & (kind == _QUERY)
+    eff = jnp.minimum(arg, cfg_num2)
+    q_obs = jnp.where(
+        is_q, jnp.take(hist, jnp.clip(eff, 0, ncfg - 1)) & 0x7FFFFFFF, -1
+    )
+
+    return member, owner, hist, cfg_num2, last_seq, fresh, client, seq, q_obs, viol
+
+
+class CtrlerState(NamedTuple):
+    """Raft cluster + the 4A service layer (vmap adds the cluster axis)."""
+
+    raft: ClusterState
+    # --- clerks [NC] ---
+    clerk_seq: jax.Array    # i32 last started seq (0 = none yet)
+    clerk_out: jax.Array    # bool: outstanding
+    clerk_arg: jax.Array    # i32 packed arg of the outstanding op
+    clerk_kind: jax.Array   # i32 op kind
+    clerk_acked: jax.Array  # i32 highest committed seq
+    clerk_q_obs: jax.Array  # i32 node-served Query observation (-1 = none)
+    queries_done: jax.Array  # i32 completed Queries (workload metric)
+    # --- per-node apply machines (live + persisted snapshot) ---
+    applied: jax.Array      # i32 [N] apply cursor, absolute
+    last_seq: jax.Array     # i32 [N, NC] dup table
+    member: jax.Array       # bool [N, NG]
+    owner: jax.Array        # i32 [N, NS]; -1 = unowned
+    cfg_num: jax.Array      # i32 [N]
+    hist: jax.Array         # i32 [N, NCFG] config hash per num
+    snap_last_seq: jax.Array
+    snap_member: jax.Array
+    snap_owner: jax.Array
+    snap_cfg_num: jax.Array
+    snap_hist: jax.Array
+    # --- truth walker (canonical state machine on the committed shadow) ---
+    w_frontier: jax.Array   # i32 walker cursor, absolute
+    w_last_seq: jax.Array   # i32 [NC]
+    w_member: jax.Array     # bool [NG]
+    w_owner: jax.Array      # i32 [NS]
+    w_cfg_num: jax.Array    # i32
+    w_hist: jax.Array       # i32 [NCFG]
+    w_acked: jax.Array      # i32 [NC] walker-accepted seq per client
+    w_q_seq: jax.Array      # i32 [NC] seq of the walker's last Query per client
+    w_q_obs: jax.Array      # i32 [NC] the walker's answer for it
+
+
+def _check_ctrler_cfg(cfg: SimConfig) -> None:
+    assert cfg.p_client_cmd == 0.0, "ctrler layer owns command injection"
+    assert not cfg.compact_at_commit, (
+        "ctrler fuzzing needs cfg.compact_at_commit=False: the compaction "
+        "boundary must follow the apply cursor, not the commit index"
+    )
+
+
+def init_ctrler_cluster(
+    cfg: SimConfig, kcfg: CtrlerConfig, key: jax.Array, kn=None
+) -> CtrlerState:
+    n, nc = cfg.n_nodes, kcfg.n_clients
+    ng, ncfg = kcfg.n_gids, kcfg.n_configs
+    # config 0: no groups, every shard unowned (the reference's initial
+    # Config{num: 0, shards: [0; 10]}, shard_ctrler/msg.rs:10-18)
+    h0 = _hash_config(jnp.zeros((ng,), jnp.bool_),
+                      jnp.full((N_SHARDS,), -1, I32), jnp.asarray(0, I32))
+    hist0 = jnp.zeros((ncfg,), I32).at[0].set(h0)
+    return CtrlerState(
+        raft=init_cluster(cfg, key, kn),
+        clerk_seq=jnp.zeros((nc,), I32),
+        clerk_out=jnp.zeros((nc,), jnp.bool_),
+        clerk_arg=jnp.zeros((nc,), I32),
+        clerk_kind=jnp.zeros((nc,), I32),
+        clerk_acked=jnp.zeros((nc,), I32),
+        clerk_q_obs=jnp.full((nc,), -1, I32),
+        queries_done=jnp.zeros((nc,), I32),
+        applied=jnp.zeros((n,), I32),
+        last_seq=jnp.zeros((n, nc), I32),
+        member=jnp.zeros((n, ng), jnp.bool_),
+        owner=jnp.full((n, N_SHARDS), -1, I32),
+        cfg_num=jnp.zeros((n,), I32),
+        hist=jnp.broadcast_to(hist0, (n, ncfg)),
+        snap_last_seq=jnp.zeros((n, nc), I32),
+        snap_member=jnp.zeros((n, ng), jnp.bool_),
+        snap_owner=jnp.full((n, N_SHARDS), -1, I32),
+        snap_cfg_num=jnp.zeros((n,), I32),
+        snap_hist=jnp.broadcast_to(hist0, (n, ncfg)),
+        w_frontier=jnp.asarray(0, I32),
+        w_last_seq=jnp.zeros((nc,), I32),
+        w_member=jnp.zeros((ng,), jnp.bool_),
+        w_owner=jnp.full((N_SHARDS,), -1, I32),
+        w_cfg_num=jnp.asarray(0, I32),
+        w_hist=hist0,
+        w_acked=jnp.zeros((nc,), I32),
+        w_q_seq=jnp.zeros((nc,), I32),
+        w_q_obs=jnp.full((nc,), -1, I32),
+    )
+
+
+def ctrler_step(
+    cfg: SimConfig, kcfg: CtrlerConfig, ks: CtrlerState, cluster_key: jax.Array,
+    kn=None, ckn=None,
+) -> CtrlerState:
+    """One lockstep tick: raft tick, apply machines, walker, oracles, clerks."""
+    if kn is None:
+        _check_ctrler_cfg(cfg)
+        kn = cfg.knobs()
+    if ckn is None:
+        ckn = kcfg.knobs()
+    n, cap, nc = cfg.n_nodes, cfg.log_cap, kcfg.n_clients
+    me = jnp.arange(n, dtype=I32)
+
+    pre = ks.raft
+    s = step_cluster(cfg, pre, cluster_key, kn)
+    t = s.tick
+    key = jax.random.fold_in(cluster_key, t)
+
+    applied, last_seq = ks.applied, ks.last_seq
+    member, owner = ks.member, ks.owner
+    cfg_num, hist = ks.cfg_num, ks.hist
+    snap_last_seq, snap_member = ks.snap_last_seq, ks.snap_member
+    snap_owner, snap_cfg_num = ks.snap_owner, ks.snap_cfg_num
+    snap_hist = ks.snap_hist
+
+    # 1. Crash/restart: live machine resets to the persisted snapshot; replay
+    #    from base rebuilds the rest (restore-then-replay, raft.rs:194-211).
+    fresh_node = (~pre.alive & s.alive) | ~s.alive
+    fz = fresh_node[:, None]
+    applied = jnp.where(fresh_node, s.base, applied)
+    last_seq = jnp.where(fz, snap_last_seq, last_seq)
+    member = jnp.where(fz, snap_member, member)
+    owner = jnp.where(fz, snap_owner, owner)
+    cfg_num = jnp.where(fresh_node, snap_cfg_num, cfg_num)
+    hist = jnp.where(fz, snap_hist, hist)
+
+    # 2. Compaction: capture the live tables as the persisted snapshot at the
+    #    new base (the boundary is the pre-tick apply cursor; kv.py pattern).
+    inst = s.snap_installed_src >= 0
+    comp = (s.base != pre.base) & ~inst & s.alive
+    cz = comp[:, None]
+    snap_last_seq = jnp.where(cz, last_seq, snap_last_seq)
+    snap_member = jnp.where(cz, member, snap_member)
+    snap_owner = jnp.where(cz, owner, snap_owner)
+    snap_cfg_num = jnp.where(comp, cfg_num, snap_cfg_num)
+    snap_hist = jnp.where(cz, hist, snap_hist)
+
+    # 3. Install-snapshot: adopt the SENDER's persisted snapshot (one-hot over
+    #    the tiny node axis) as live + persisted state; jump the cursor.
+    src_oh = (me[None, :] == s.snap_installed_src[:, None])[:, :, None]
+
+    def _adopt(snap):
+        return jnp.sum(jnp.where(src_oh, snap[None, :, :], 0), axis=1)
+
+    ad_last_seq = _adopt(ks.snap_last_seq)
+    ad_member = _adopt(ks.snap_member.astype(I32)) > 0
+    ad_owner = jnp.sum(
+        jnp.where(src_oh, ks.snap_owner[None, :, :] + 1, 0), axis=1
+    ) - 1  # +1/-1: the -1 sentinel must survive the masked sum
+    ad_cfg_num = jnp.sum(
+        jnp.where(src_oh[:, :, 0], ks.snap_cfg_num[None, :], 0), axis=1
+    )
+    ad_hist = _adopt(ks.snap_hist)
+    iz = inst[:, None]
+    applied = jnp.where(inst, s.base, applied)
+    last_seq = jnp.where(iz, ad_last_seq, last_seq)
+    member = jnp.where(iz, ad_member, member)
+    owner = jnp.where(iz, ad_owner, owner)
+    cfg_num = jnp.where(inst, ad_cfg_num, cfg_num)
+    hist = jnp.where(iz, ad_hist, hist)
+    snap_last_seq = jnp.where(iz, ad_last_seq, snap_last_seq)
+    snap_member = jnp.where(iz, ad_member, snap_member)
+    snap_owner = jnp.where(iz, ad_owner, snap_owner)
+    snap_cfg_num = jnp.where(inst, ad_cfg_num, snap_cfg_num)
+    snap_hist = jnp.where(iz, ad_hist, snap_hist)
+
+    # ---------------------------------------------------------- apply machines
+    viol = jnp.asarray(0, I32)
+    lane = jnp.arange(cap, dtype=I32)[None, :]
+    clerk_q_obs = ks.clerk_q_obs
+    cl_ids = jnp.arange(nc, dtype=I32)
+    # the rotate bug's per-node tie-break rotation (0 when off / for walker)
+    node_rot = jnp.where(ckn.bug_rotate_tiebreak, me, 0)
+    apply_one = jax.vmap(
+        functools.partial(_apply_entry, kcfg, ckn),
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0),
+    )
+    for _ in range(kcfg.apply_max):
+        can = s.alive & (applied < s.commit)
+        pos = _slot(applied + 1, cap)
+        val = jnp.sum(jnp.where(lane == pos[:, None], s.log_val, 0), axis=-1)
+        (member, owner, hist, cfg_num, last_seq,
+         fresh, client, seq, q_obs, v) = apply_one(
+            node_rot, member, owner, hist, cfg_num, last_seq, val, can)
+        for bit in (VIOLATION_CTRL_BALANCE, VIOLATION_CTRL_MINIMAL):
+            viol |= jnp.where(jnp.any(v & bit != 0), bit, 0)
+        # Query observation: the reply is a pure function of the log prefix,
+        # so the first node to apply it yields the canonical answer (replica
+        # agreement is checked by CTRL_DIVERGE)
+        m = (
+            (fresh & (q_obs >= 0))[None, :]
+            & (client[None, :] == cl_ids[:, None])
+            & (seq[None, :] == ks.clerk_seq[:, None])
+        )  # [nc, n]
+        cand = jnp.max(jnp.where(m, q_obs[None, :], -1), axis=1)
+        clerk_q_obs = jnp.where(
+            (clerk_q_obs < 0) & (cand >= 0), cand, clerk_q_obs
+        )
+        applied = jnp.where(can, applied + 1, applied)
+
+    # ------------------------------------------------------------ truth walker
+    w_frontier, w_last_seq = ks.w_frontier, ks.w_last_seq
+    w_member, w_owner = ks.w_member, ks.w_owner
+    w_cfg_num, w_hist = ks.w_cfg_num, ks.w_hist
+    w_acked, w_q_seq, w_q_obs = ks.w_acked, ks.w_q_seq, ks.w_q_obs
+    sh_abs = _lane_abs(s.shadow_base, cap)  # [cap]
+    lane1 = jnp.arange(cap, dtype=I32)
+    for _ in range(kcfg.walk_max):
+        canw = w_frontier < s.shadow_len
+        posw = _slot(w_frontier + 1, cap)
+        in_win = jnp.any((lane1 == posw) & (sh_abs == w_frontier + 1))
+        canw = canw & in_win
+        val = jnp.sum(jnp.where(lane1 == posw, s.shadow_val, 0))
+        (w_member, w_owner, w_hist, w_cfg_num, w_last_seq,
+         fresh, client, seq, q_obs, v) = _apply_entry(
+            kcfg, ckn, jnp.asarray(0, I32), w_member, w_owner, w_hist,
+            w_cfg_num, w_last_seq, val, canw)
+        viol |= v
+        cl_oh = cl_ids == client
+        w_acked = jnp.maximum(w_acked, jnp.where(cl_oh & fresh, seq, 0))
+        hit_q = cl_oh & fresh & (q_obs >= 0)
+        w_q_seq = jnp.where(hit_q, seq, w_q_seq)
+        w_q_obs = jnp.where(hit_q, q_obs, w_q_obs)
+        w_frontier = jnp.where(canw, w_frontier + 1, w_frontier)
+
+    # ----------------------------------------------------------------- oracles
+    # Divergence: an alive node at exactly the walker frontier must equal the
+    # canonical state machine bit-for-bit (README.md:79's determinism rule —
+    # replica-divergent rebalance is THE classic 4A bug).
+    at_frontier = s.alive & (applied == w_frontier)  # [N]
+    m_all = (
+        jnp.all(member == w_member[None, :], axis=1)
+        & jnp.all(owner == w_owner[None, :], axis=1)
+        & (cfg_num == w_cfg_num)
+        & jnp.all(hist == w_hist[None, :], axis=1)
+        & jnp.all(last_seq == w_last_seq[None, :], axis=1)
+    )
+    viol |= jnp.where(jnp.any(at_frontier & ~m_all), VIOLATION_CTRL_DIVERGE, 0)
+
+    # ------------------------------------------------------------------ clerks
+    want = _pack(kcfg, cl_ids, ks.clerk_seq, ks.clerk_arg, ks.clerk_kind)
+    sh_live = _lane_abs(s.shadow_base, cap) <= s.shadow_len
+    in_shadow = jnp.any(
+        (s.shadow_val[None, :] == want[:, None]) & sh_live[None, :], axis=1
+    )
+    is_q = ks.clerk_kind == _QUERY
+    newly_acked = ks.clerk_out & in_shadow & (
+        ~is_q | ((clerk_q_obs >= 0) & (w_q_seq == ks.clerk_seq))
+    )
+    done_q = newly_acked & is_q
+    # Historical-query correctness: the served config must equal the walker's
+    # answer for the same (client, seq) — query_at across restarts/failovers.
+    viol |= jnp.where(
+        jnp.any(done_q & (clerk_q_obs != w_q_obs)), VIOLATION_CTRL_QUERY, 0
+    )
+    clerk_acked = jnp.where(newly_acked, ks.clerk_seq, ks.clerk_acked)
+    clerk_out = ks.clerk_out & ~newly_acked
+    queries_done = ks.queries_done + done_q.astype(I32)
+
+    # start fresh ops / retry pending ones
+    kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 4)
+    start = (
+        ~clerk_out
+        & jax.random.bernoulli(kk[0], ckn.p_op, (nc,))
+        & (ks.clerk_seq < _SEQ_LIM - 1)
+    )
+    clerk_seq = jnp.where(start, ks.clerk_seq + 1, ks.clerk_seq)
+    u_kind = jax.random.uniform(jax.random.fold_in(key, _S_CLERK_KIND), (nc,))
+    new_kind = jnp.where(
+        u_kind < ckn.p_query, _QUERY,
+        jnp.where(
+            u_kind < ckn.p_query + ckn.p_move, _MOVE,
+            # Join/Leave split evenly on the residual probability mass
+            jnp.where(
+                u_kind < ckn.p_query + ckn.p_move
+                + (1.0 - ckn.p_query - ckn.p_move) * 0.5,
+                _JOIN, _LEAVE,
+            ),
+        ),
+    )
+    # arg draws: gid for Join/Leave; (shard, gid) for Move; num (incl. the
+    # "latest" sentinel ARG_LIM-1) for Query — one randint reduced per kind
+    raw = jax.random.randint(
+        kk[1], (nc,), 0, N_SHARDS * kcfg.n_gids, dtype=I32
+    )
+    new_arg = jnp.where(
+        new_kind == _QUERY,
+        jnp.where(
+            raw % 4 == 0, kcfg._arg_lim - 1,  # "latest" 25% of the time
+            raw % (kcfg.n_configs + 1),
+        ),
+        jnp.where(new_kind == _MOVE, raw, raw % kcfg.n_gids),
+    )
+    clerk_kind = jnp.where(start, new_kind, ks.clerk_kind)
+    clerk_arg = jnp.where(start, new_arg, ks.clerk_arg)
+    clerk_q_obs = jnp.where(start, -1, clerk_q_obs)
+    clerk_out = clerk_out | start
+    retry = clerk_out & (
+        start | jax.random.bernoulli(kk[2], ckn.p_retry, (nc,))
+    )
+    target = jax.random.randint(kk[3], (nc,), 0, n, dtype=I32)
+
+    violations = s.violations | viol
+    first_violation_tick = jnp.where(
+        (s.first_violation_tick < 0) & (viol != 0), t, s.first_violation_tick
+    )
+
+    # submit: append at the targeted node iff it believes it is the leader
+    # (kv.py submit loop; stale-leader acceptance is the rejoin_2b hazard)
+    log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
+    for c in range(nc):
+        sel = me == target[c]
+        ok = (
+            sel
+            & retry[c]
+            & s.alive
+            & (s.role == LEADER)
+            & (log_len - s.base < cap)
+            & (log_len - s.commit < kn.flow_cap)
+        )
+        v = _pack(kcfg, jnp.asarray(c, I32), clerk_seq[c], clerk_arg[c],
+                  clerk_kind[c])
+        hit = ok[:, None] & (lane == _slot(log_len + 1, cap)[:, None])
+        log_term = jnp.where(hit, s.term[:, None], log_term)
+        log_val = jnp.where(hit, v, log_val)
+        log_len = jnp.where(ok, log_len + 1, log_len)
+
+    raft = s._replace(
+        log_term=log_term,
+        log_val=log_val,
+        log_len=log_len,
+        violations=violations,
+        first_violation_tick=first_violation_tick,
+        compact_floor=applied,
+    )
+    return CtrlerState(
+        raft=raft,
+        clerk_seq=clerk_seq,
+        clerk_out=clerk_out,
+        clerk_arg=clerk_arg,
+        clerk_kind=clerk_kind,
+        clerk_acked=clerk_acked,
+        clerk_q_obs=clerk_q_obs,
+        queries_done=queries_done,
+        applied=applied,
+        last_seq=last_seq,
+        member=member,
+        owner=owner,
+        cfg_num=cfg_num,
+        hist=hist,
+        snap_last_seq=snap_last_seq,
+        snap_member=snap_member,
+        snap_owner=snap_owner,
+        snap_cfg_num=snap_cfg_num,
+        snap_hist=snap_hist,
+        w_frontier=w_frontier,
+        w_last_seq=w_last_seq,
+        w_member=w_member,
+        w_owner=w_owner,
+        w_cfg_num=w_cfg_num,
+        w_hist=w_hist,
+        w_acked=w_acked,
+        w_q_seq=w_q_seq,
+        w_q_obs=w_q_obs,
+    )
+
+
+# ------------------------------------------------------------------- drivers
+class CtrlerFuzzReport(NamedTuple):
+    violations: np.ndarray            # i32 bitmask per cluster
+    first_violation_tick: np.ndarray  # -1 = none
+    acked_ops: np.ndarray             # committed clerk ops per cluster
+    queries_done: np.ndarray          # completed Queries per cluster
+    configs_created: np.ndarray       # walker config num per cluster
+    committed: np.ndarray             # committed log entries per cluster
+    msg_count: np.ndarray
+    snap_installs: np.ndarray
+
+    @property
+    def n_violating(self) -> int:
+        return int((self.violations != 0).sum())
+
+    def violating_clusters(self) -> np.ndarray:
+        return np.nonzero(self.violations != 0)[0]
+
+
+@functools.lru_cache(maxsize=None)
+def _ctrler_program(
+    static_cfg: SimConfig, static_kcfg: CtrlerConfig, n_clusters: int,
+    mesh: Optional[Mesh],
+):
+    """One compiled program per static shape; probabilities, bug modes, and
+    tick count are runtime args (uniform scalars — the fast knob layout)."""
+    constraint = None
+    if mesh is not None:
+        constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
+
+    def run(seed, kn, ckn, n_ticks) -> CtrlerState:
+        base = jax.random.PRNGKey(seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(n_clusters)
+        )
+        states = jax.vmap(
+            functools.partial(init_ctrler_cluster, static_cfg, static_kcfg),
+            in_axes=(0, None),
+        )(keys, kn)
+        if constraint is not None:
+            states = jax.lax.with_sharding_constraint(
+                states, jax.tree.map(lambda _: constraint, states)
+            )
+            keys = jax.lax.with_sharding_constraint(keys, constraint)
+
+        def body(_, carry):
+            return jax.vmap(
+                functools.partial(ctrler_step, static_cfg, static_kcfg),
+                in_axes=(0, 0, None, None),
+            )(carry, keys, kn, ckn)
+
+        return jax.lax.fori_loop(0, n_ticks, body, states)
+
+    return jax.jit(run)
+
+
+def make_ctrler_fuzz_fn(
+    cfg: SimConfig,
+    kcfg: CtrlerConfig,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+):
+    """Build fn(seed) -> final batched CtrlerState (see engine.make_fuzz_fn)."""
+    _check_ctrler_cfg(cfg)
+    prog = _ctrler_program(cfg.static_key(), kcfg.static_key(), n_clusters, mesh)
+    kn = cfg.knobs()
+    ckn = kcfg.knobs()
+    ticks = jnp.asarray(n_ticks, jnp.int32)
+    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, ckn, ticks)
+
+
+def ctrler_report(final: CtrlerState) -> CtrlerFuzzReport:
+    return CtrlerFuzzReport(
+        violations=np.asarray(final.raft.violations),
+        first_violation_tick=np.asarray(final.raft.first_violation_tick),
+        acked_ops=np.asarray(final.clerk_acked.sum(axis=-1)),
+        queries_done=np.asarray(final.queries_done.sum(axis=-1)),
+        configs_created=np.asarray(final.w_cfg_num),
+        committed=np.asarray(final.raft.shadow_len),
+        msg_count=np.asarray(final.raft.msg_count),
+        snap_installs=np.asarray(final.raft.snap_install_count),
+    )
+
+
+def ctrler_fuzz(
+    cfg: SimConfig,
+    kcfg: CtrlerConfig,
+    seed: int,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+) -> CtrlerFuzzReport:
+    """Fuzz the 4A config service over n_clusters independent clusters."""
+    fn = make_ctrler_fuzz_fn(cfg, kcfg, n_clusters, n_ticks, mesh=mesh)
+    final = jax.block_until_ready(fn(jnp.asarray(seed, jnp.uint32)))
+    return ctrler_report(final)
+
+
+@functools.lru_cache(maxsize=None)
+def _ctrler_replay_program(static_cfg: SimConfig, static_kcfg: CtrlerConfig):
+    def run(cluster_id, kn, ckn, n_ticks, seed):
+        ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
+        state = init_ctrler_cluster(static_cfg, static_kcfg, ckey, kn)
+
+        def body(_, carry):
+            return ctrler_step(static_cfg, static_kcfg, carry, ckey, kn, ckn)
+
+        return jax.lax.fori_loop(0, n_ticks, body, state)
+
+    return jax.jit(run)
+
+
+def ctrler_replay_cluster(
+    cfg: SimConfig, kcfg: CtrlerConfig, seed: int, cluster_id: int,
+    n_ticks: int,
+) -> CtrlerState:
+    """Re-run one cluster exactly (the (seed, cluster_id) replay contract)."""
+    _check_ctrler_cfg(cfg)
+    prog = _ctrler_replay_program(cfg.static_key(), kcfg.static_key())
+    return jax.block_until_ready(
+        prog(jnp.asarray(cluster_id, jnp.int32), cfg.knobs(), kcfg.knobs(),
+             jnp.asarray(n_ticks, jnp.int32), jnp.asarray(seed, jnp.uint32))
+    )

@@ -72,5 +72,10 @@ def test_cli_service_layers():
     rc, out = run(["kv-fuzz", "--clusters", "32", "--ticks", "256", "--storm"])
     assert rc == 0 and out["violating"] == 0 and out["acked_ops_mean"] > 0
 
+    rc, out = run(["ctrler-fuzz", "--clusters", "16", "--ticks", "256",
+                   "--storm"])
+    assert rc == 0 and out["violating"] == 0, out
+    assert out["configs_created_mean"] > 0 and out["queries_done_mean"] > 0
+
     rc, out = run(["shardkv-fuzz", "--clusters", "8", "--ticks", "440"])
     assert rc == 0 and out["violating"] == 0 and out["installs_mean"] > 0

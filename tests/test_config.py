@@ -8,6 +8,7 @@ import dataclasses
 import pytest
 
 from madraft_tpu.tpusim.config import Knobs, SimConfig
+from madraft_tpu.tpusim.ctrler import CtrlerConfig, CtrlerKnobs
 from madraft_tpu.tpusim.engine import _validate_knobs, make_sweep_fn
 from madraft_tpu.tpusim.kv import KvConfig, KvKnobs
 
@@ -44,6 +45,17 @@ def test_kvconfig_fields_all_reach_the_program():
             continue
         assert f.name in knob_names, (
             f"KvConfig.{f.name} is neither static nor a knob"
+        )
+
+
+def test_ctrlerconfig_fields_all_reach_the_program():
+    static = {"n_gids", "n_clients", "n_configs", "apply_max", "walk_max"}
+    knob_names = set(CtrlerKnobs._fields)
+    for f in dataclasses.fields(CtrlerConfig):
+        if f.name in static:
+            continue
+        assert f.name in knob_names, (
+            f"CtrlerConfig.{f.name} is neither static nor a knob"
         )
 
 

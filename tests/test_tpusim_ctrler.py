@@ -1,0 +1,241 @@
+"""Shard-controller fuzzing layer tests (Lab 4A on TPU): the canonical
+rebalance (balance + minimality + determinism, cross-validated against an
+independent numpy model), fuzzing under fault storms, oracle validation via
+the three planted 4A bugs, determinism, replay, and sharded execution.
+
+The reference 4A suite (/root/reference/src/shard_ctrler/tests.rs) asserts
+balance (tester.rs:113-150), minimal transfers (tests.rs:122-163,239-278),
+historical query_at (tests.rs:64-75), and config equality across leader
+failover (tests.rs:280-296); these tests are the batched analogue.
+
+Runs on the 8-device virtual CPU mesh from conftest.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.ctrler import (
+    N_SHARDS,
+    VIOLATION_CTRL_BALANCE,
+    VIOLATION_CTRL_DIVERGE,
+    VIOLATION_CTRL_MINIMAL,
+    VIOLATION_CTRL_QUERY,
+    CtrlerConfig,
+    _min_moves,
+    _rebalance,
+    ctrler_fuzz,
+    ctrler_replay_cluster,
+    ctrler_report,
+    make_ctrler_fuzz_fn,
+)
+from madraft_tpu.tpusim.state import I32
+
+BASE = SimConfig(
+    n_nodes=5,
+    p_client_cmd=0.0,         # the ctrler layer owns command injection
+    compact_at_commit=False,  # the layer drives the compaction boundary
+    loss_prob=0.1,
+    p_crash=0.01,
+    p_restart=0.2,
+    max_dead=2,
+    p_repartition=0.02,
+    p_heal=0.05,
+    log_cap=32,
+    compact_every=8,
+)
+CT = CtrlerConfig()
+NG = CT.n_gids
+
+
+# ------------------------------------------------------ numpy reference model
+def ref_rebalance(member, owner):
+    """Independent model of the canonical rebalance (module docstring of
+    ctrler.py): orphans to the least-loaded member (ties: lowest gid), then
+    one max->min move at a time until max-min <= 1."""
+    ng = len(member)
+    own = [g if (0 <= g < ng and member[g]) else -1 for g in owner]
+    memb = [g for g in range(ng) if member[g]]
+    if not memb:
+        return [-1] * len(owner)
+    for _ in range(len(owner)):
+        counts = {g: sum(1 for x in own if x == g) for g in memb}
+        dst = min(memb, key=lambda g: (counts[g], g))
+        src = max(memb, key=lambda g: (counts[g], -g))
+        if -1 in own:
+            own[own.index(-1)] = dst
+        elif counts[src] - counts[dst] > 1:
+            own[own.index(src)] = dst
+        else:
+            break
+    return own
+
+
+def ref_min_moves(member, owner):
+    ng = len(member)
+    ns = len(owner)
+    k = sum(member)
+    valid = [0 <= g < ng and member[g] for g in owner]
+    orphans = valid.count(False)
+    retained = [
+        sum(1 for s in range(ns) if valid[s] and owner[s] == g)
+        for g in range(ng)
+    ]
+    q, r = divmod(ns, k)
+    ret = sorted((retained[g] for g in range(ng) if member[g]), reverse=True)
+    shed = sum(max(0, c - (q + 1 if i < r else q)) for i, c in enumerate(ret))
+    return orphans + shed
+
+
+def _random_states(rng, n_cases):
+    for _ in range(n_cases):
+        member = rng.random(NG) < 0.6
+        if not member.any():
+            member[rng.integers(NG)] = True
+        # owners drawn from {-1} + all gids (including non-members: models the
+        # post-Leave orphaning the rebalance must fix)
+        owner = rng.integers(-1, NG, size=N_SHARDS)
+        yield member.tolist(), owner.tolist()
+
+
+def test_rebalance_matches_numpy_model():
+    """The jnp rebalance equals the independent numpy model exactly, and the
+    result is balanced, orphan-free, and minimal (moved == closed-form
+    minimum) over hundreds of random membership/owner states."""
+    rng = np.random.default_rng(42)
+    off = jnp.bool_(False)
+    for member, owner in _random_states(rng, 300):
+        got = np.asarray(
+            _rebalance(NG, jnp.asarray(member), jnp.asarray(owner, I32),
+                       jnp.asarray(0, I32), off, off)
+        )
+        want = np.asarray(ref_rebalance(member, owner))
+        np.testing.assert_array_equal(got, want, err_msg=f"{member} {owner}")
+        # balance + no orphans
+        counts = [int((got == g).sum()) for g in range(NG) if member[g]]
+        assert all(member[g] for g in got), f"orphan/non-member in {got}"
+        assert max(counts) - min(counts) <= 1, f"unbalanced {counts}"
+        # minimality vs the pre-state (only shards with still-member owners
+        # can be retained; the rest necessarily move)
+        moved = int(
+            (got != np.asarray(owner)).sum()
+        )
+        assert moved == ref_min_moves(member, owner), (
+            f"{moved} moves, min {ref_min_moves(member, owner)} "
+            f"for {member} {owner}"
+        )
+        assert ref_min_moves(member, owner) == int(
+            _min_moves(NG, jnp.asarray(member), jnp.asarray(owner, I32))
+        )
+
+
+def test_rebalance_tie_rotation_permutes_but_stays_balanced():
+    """Rotated tie-breaking (the planted divergence bug) must still produce a
+    balanced minimal assignment — only a DIFFERENT one, so the divergence
+    oracle (not balance/minimality) is what catches it."""
+    rng = np.random.default_rng(7)
+    off = jnp.bool_(False)
+    differs = 0
+    for member, owner in _random_states(rng, 60):
+        a = np.asarray(_rebalance(NG, jnp.asarray(member),
+                                  jnp.asarray(owner, I32),
+                                  jnp.asarray(0, I32), off, off))
+        b = np.asarray(_rebalance(NG, jnp.asarray(member),
+                                  jnp.asarray(owner, I32),
+                                  jnp.asarray(1, I32), off, off))
+        counts = [int((b == g).sum()) for g in range(NG) if member[g]]
+        assert max(counts) - min(counts) <= 1
+        assert int((b != np.asarray(owner)).sum()) == ref_min_moves(member, owner)
+        differs += int(not np.array_equal(a, b))
+    assert differs > 10, "rotation never changed an assignment — bug is inert"
+
+
+def test_ctrler_fuzz_clean():
+    """Fault storm over many clusters: no violations; Join/Leave/Move/Query
+    all flow (configs are created and historical queries complete)."""
+    rep = ctrler_fuzz(BASE, CT, seed=11, n_clusters=96, n_ticks=320)
+    assert rep.n_violating == 0, (
+        f"violations in clusters {rep.violating_clusters()[:8]}: "
+        f"{rep.violations[rep.violating_clusters()[:8]]}"
+    )
+    assert (rep.acked_ops > 0).mean() > 0.9
+    assert rep.configs_created.sum() > 96 * 3, "reconfigurations must flow"
+    assert rep.queries_done.sum() > 96, "historical queries must complete"
+
+
+def test_ctrler_rotate_tiebreak_diverges():
+    """Node-id-rotated tie-breaking — the batched analogue of iterating a
+    HashMap in the rebalance (README.md:79's determinism warning) — must trip
+    the replica-divergence oracle, NOT balance/minimality (each replica's
+    answer is individually balanced and minimal, they just disagree)."""
+    rep = ctrler_fuzz(BASE, CT.replace(bug_rotate_tiebreak=True), seed=11,
+                      n_clusters=96, n_ticks=320)
+    assert rep.n_violating > 0, "replica-divergent rebalance escaped"
+    bits = rep.violations[rep.violating_clusters()]
+    assert (bits & VIOLATION_CTRL_DIVERGE).any()
+    assert not (bits & (VIOLATION_CTRL_BALANCE | VIOLATION_CTRL_MINIMAL)).any()
+    # diverged replicas also serve diverging historical-query answers, so the
+    # query_at oracle must catch some of them — this validates CTRL_QUERY
+    assert (bits & VIOLATION_CTRL_QUERY).any(), (
+        "no diverged query observation was caught by the query_at oracle"
+    )
+
+
+def test_ctrler_greedy_rebalance_unbalances():
+    """Dumping every orphan on one group with no balancing pass must trip the
+    balance oracle (tester.rs:134-150's max-min<=1 check)."""
+    rep = ctrler_fuzz(BASE, CT.replace(bug_greedy_rebalance=True), seed=11,
+                      n_clusters=96, n_ticks=320)
+    assert rep.n_violating > 0, "unbalanced rebalance escaped"
+    bits = rep.violations[rep.violating_clusters()]
+    assert (bits & VIOLATION_CTRL_BALANCE).any()
+
+
+def test_ctrler_full_reshuffle_moves_too_much():
+    """A balanced from-scratch reassignment that ignores retention must trip
+    the minimality oracle (tests.rs:122-163's minimal-transfer checks) while
+    staying balanced."""
+    rep = ctrler_fuzz(BASE, CT.replace(bug_full_reshuffle=True), seed=11,
+                      n_clusters=96, n_ticks=384)
+    assert rep.n_violating > 0, "retention-blind rebalance escaped"
+    bits = rep.violations[rep.violating_clusters()]
+    assert (bits & VIOLATION_CTRL_MINIMAL).any()
+    assert not (bits & VIOLATION_CTRL_BALANCE).any(), (
+        "round-robin reassignment is balanced; only minimality should fire"
+    )
+
+
+def test_ctrler_deterministic_and_replay():
+    """Same seed => bit-identical report; single-cluster replay reproduces —
+    the (seed, cluster_id) replay contract (README.md:42-55)."""
+    r1 = ctrler_fuzz(BASE, CT, seed=123, n_clusters=48, n_ticks=256)
+    r2 = ctrler_fuzz(BASE, CT, seed=123, n_clusters=48, n_ticks=256)
+    for a, b in zip(r1, r2):
+        np.testing.assert_array_equal(a, b)
+    final = ctrler_replay_cluster(BASE, CT, seed=123, cluster_id=3,
+                                  n_ticks=256)
+    assert int(final.raft.violations) == int(r1.violations[3])
+    assert int(final.clerk_acked.sum()) == int(r1.acked_ops[3])
+    assert int(final.w_cfg_num) == int(r1.configs_created[3])
+    assert int(final.raft.msg_count) == int(r1.msg_count[3])
+
+
+def test_ctrler_sharded_over_mesh():
+    """The cluster axis shards over the 8-device mesh, results identical."""
+    devs = np.array(jax.devices()[:8])
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    mesh = jax.sharding.Mesh(devs, ("clusters",))
+    fn = make_ctrler_fuzz_fn(BASE, CT, n_clusters=64, n_ticks=128, mesh=mesh)
+    rep_sharded = ctrler_report(
+        jax.block_until_ready(fn(jnp.asarray(5, jnp.uint32)))
+    )
+    rep_local = ctrler_fuzz(BASE, CT, seed=5, n_clusters=64, n_ticks=128)
+    np.testing.assert_array_equal(rep_sharded.violations, rep_local.violations)
+    np.testing.assert_array_equal(rep_sharded.acked_ops, rep_local.acked_ops)
+    np.testing.assert_array_equal(
+        rep_sharded.configs_created, rep_local.configs_created
+    )
+    assert rep_sharded.n_violating == 0
