@@ -4,7 +4,8 @@ grid (the wide-net companion to the targeted test suite; ~6 min on CPU).
 Covers: a 16-combo loss x crash x repartition sweep in ONE compiled program;
 raft shape corners (3/4/5/7 nodes, ae_max 1..8, log_cap 32..128,
 compact_every 1..48, leader-targeted + asymmetric cuts); kv extremes
-(apply_max=1 backlog, 8 hot clients on 2 keys); shardkv topologies
+(apply_max=1 backlog, 8 hot clients on 2 keys); ctrler extremes (hot clerks,
+wide gid universe, query-heavy, starved walker); shardkv topologies
 (2..4 groups, 4..10 shards, 3..5 nodes/group). Exits non-zero on any
 violation OR liveness anomaly (a config that stops committing / stalls its
 schedule), which is how round 3's response-starvation and GC-leak bugs were
@@ -75,6 +76,28 @@ for kv, ticks in [
     rr = kv_fuzz(kcfg_base, kv, seed=88, n_clusters=32, n_ticks=ticks)
     check(f"kv nc={kv.n_clients} am={kv.apply_max}", rr.n_violating == 0,
           f"viol={rr.n_violating} acked={rr.acked_ops.mean():.0f}")
+
+# 3b. ctrler (4A) extremes: many hot clerks churning tiny config histories,
+# a wide gid universe, and a query-heavy mix
+from madraft_tpu.tpusim.ctrler import CtrlerConfig, ctrler_fuzz
+
+ccfg_base = kcfg_base.replace(log_cap=32, compact_every=8)
+for ct, ticks in [
+    (CtrlerConfig(n_clients=8, n_configs=12, p_op=0.8, p_retry=0.9), 768),
+    (CtrlerConfig(n_gids=10, p_move=0.3, p_query=0.1), 768),
+    # walk_max must outpace the dup-entry commit rate (p_retry=1.0 appends a
+    # dup per blocked clerk per tick) or the walker legitimately falls out of
+    # the shadow window — 4/tick covers the 4-clerk worst case
+    (CtrlerConfig(apply_max=1, walk_max=4, p_retry=1.0, p_query=0.5), 768),
+]:
+    rr = ctrler_fuzz(ccfg_base, ct, seed=88, n_clusters=32, n_ticks=ticks)
+    check(f"ctrler ng={ct.n_gids} nc={ct.n_clients} am={ct.apply_max}",
+          rr.n_violating == 0,
+          f"viol={rr.n_violating} cfgs={rr.configs_created.mean():.0f} "
+          f"q={rr.queries_done.mean():.0f}")
+    check(f"  progress ng={ct.n_gids} nc={ct.n_clients} am={ct.apply_max}",
+          (rr.configs_created > 0).all() and rr.queries_done.sum() > 0,
+          f"cfg0={int((rr.configs_created == 0).sum())}")
 
 # 4. shardkv shapes
 for g, ns, nodes in [(2, 4, 3), (4, 10, 3), (3, 10, 5)]:

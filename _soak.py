@@ -3,7 +3,7 @@
 BASELINE.json sets the bar at ">=100k 5-node cluster-steps/s/chip with zero
 safety violations per 1e9 cluster-steps". This tool runs >= 1e10 cluster-steps
 on the attached accelerator — the flagship fuzz config, a harsher fault storm,
-the 16-combo knob grid, and the kv / shardkv service stacks — and records the
+the 16-combo knob grid, and the kv / ctrler / shardkv service stacks — and records the
 evidence as ``SOAK_r{N}.json``: total steps, violations (must be 0), liveness
 counters, and throughput per region.
 
@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.ctrler import CtrlerConfig, make_ctrler_fuzz_fn
 from madraft_tpu.tpusim.engine import make_fuzz_fn, make_sweep_fn, report
 from madraft_tpu.tpusim.kv import KvConfig, make_kv_fuzz_fn
 from madraft_tpu.tpusim.shardkv import (
@@ -184,6 +185,18 @@ def main() -> None:
         lambda f: (np.asarray(f.raft.violations),
                    int((np.asarray(f.clerk_acked).sum(axis=-1) > 0).sum())),
         seed0=4000,
+    ))
+
+    # --- ctrler (4A) service stack: ~2e8 steps ------------------------------
+    ccfg = flagship().replace(
+        p_client_cmd=0.0, compact_at_commit=False, log_cap=32, compact_every=8
+    )
+    fn = make_ctrler_fuzz_fn(ccfg, CtrlerConfig(), nck, ntk)
+    rows.append(drive(
+        "ctrler_fuzz", fn, nck * ntk, 2e8 * SCALE,
+        lambda f: (np.asarray(f.raft.violations),
+                   int((np.asarray(f.w_cfg_num) > 0).sum())),
+        seed0=6000,
     ))
 
     # --- shardkv service stack: ~2e8 group-cluster steps -------------------

@@ -1,5 +1,5 @@
 """Headline benchmark: batched 5-node Raft partition/crash fuzz throughput,
-plus the service layers (kv, shardkv) as secondary timed regions.
+plus the service layers (kv, ctrler, shardkv) as secondary timed regions.
 
 North star (BASELINE.json): >=100k 5-node cluster-steps/sec/chip with zero
 safety violations. Prints exactly one JSON line:
@@ -152,6 +152,29 @@ def bench_kv(n_clusters: int, n_ticks: int) -> dict:
     }
 
 
+def bench_ctrler(n_clusters: int, n_ticks: int) -> dict:
+    from madraft_tpu.tpusim.ctrler import CtrlerConfig, make_ctrler_fuzz_fn
+
+    cfg = flagship_config().replace(
+        p_client_cmd=0.0, compact_at_commit=False, log_cap=32, compact_every=8
+    )
+    fn = make_ctrler_fuzz_fn(cfg, CtrlerConfig(), n_clusters, n_ticks)
+    _ = np.asarray(fn(12345).raft.violations)  # compile + warm-up
+    best, runs, spread, final = _timed(
+        lambda: fn(12345), lambda s: np.asarray(s.raft.violations)
+    )
+    return {
+        "steps_per_sec": n_clusters * n_ticks / best,
+        "n_clusters": n_clusters,
+        "n_ticks": n_ticks,
+        "runs": runs,
+        "best_wall_s": round(best, 3),
+        "run_spread": round(spread, 3),
+        "violations": int((np.asarray(final.raft.violations) != 0).sum()),
+        "configs_created": int(np.asarray(final.w_cfg_num).sum()),
+    }
+
+
 def bench_shardkv(n_deployments: int, n_ticks: int) -> dict:
     from madraft_tpu.tpusim.shardkv import (
         ShardKvConfig,
@@ -200,6 +223,7 @@ def main() -> None:
     n_ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
     raft = bench_raft(n_clusters, n_ticks, flagship_config())
     kv = bench_kv(max(256, n_clusters // 4), max(256, n_ticks // 2))
+    ctl = bench_ctrler(max(256, n_clusters // 8), max(256, n_ticks // 2))
     skv = bench_shardkv(max(64, n_clusters // 16), max(128, n_ticks // 4))
     steps_per_sec = raft.pop("steps_per_sec")
     print(
@@ -213,6 +237,10 @@ def main() -> None:
                     **raft,
                     "kv_fuzz_steps_per_sec": round(kv.pop("steps_per_sec"), 1),
                     "kv": kv,
+                    "ctrler_fuzz_steps_per_sec": round(
+                        ctl.pop("steps_per_sec"), 1
+                    ),
+                    "ctrler": ctl,
                     "shardkv_fuzz_cluster_steps_per_sec": skv.pop(
                         "cluster_steps_per_sec"
                     ),

@@ -24,15 +24,20 @@ schedule tensor (its §4B focus is the migration protocol); THIS module is the
   is a committed read returning the config at ``min(num, latest)`` — num
   beyond the history means "latest", the u64::MAX convention (client.rs:17).
 
-Canonical rebalance (the deterministic spec both backends implement; the
-reference leaves ShardInfo::apply as a todo!() stub, server.rs:17):
+Canonical rebalance (the deterministic spec; the reference leaves
+ShardInfo::apply as a todo!() stub, server.rs:17, so the spec is ours — and
+it is deliberately CLOSED FORM rather than a greedy fixpoint loop, because
+the batched backend pays sequential depth, not op count; see _rebalance):
   1. invalidate owners that left the member set;
-  2. repeat at most N_SHARDS times: if an unowned shard exists, give the
-     lowest-numbered one to the least-loaded member (ties: lowest gid);
-     otherwise if max load - min load > 1, move the lowest-numbered shard of
-     the most-loaded member (ties: lowest gid) to the least-loaded (ties:
-     lowest gid). This is balanced AND minimal (unit-tested against an
-     exhaustive numpy model in tests/test_tpusim_ctrler.py).
+  2. targets: floor(NS/k) shards each, +1 for the NS mod k members with the
+     largest retained loads (ties: lowest gid) — ceil targets to the biggest
+     retainers is what makes the result minimal-move;
+  3. each member keeps its first min(retained, target) shards by shard
+     index; every other shard (orphans + overflow) moves;
+  4. moving shards fill member deficits in shard-index order, members
+     ordered by gid.
+Balanced AND minimal by construction (unit-tested against an independent
+numpy model in tests/test_tpusim_ctrler.py).
 
 Oracles (on-device reductions, sticky violation bits):
 - CTRL_DIVERGE: an alive node whose apply cursor equals the truth walker's
@@ -193,47 +198,96 @@ def _counts(owner, ng: int):
     ).astype(I32)
 
 
+def _retained_targets(ng: int, member, owner_oh, valid):
+    """Retained loads and per-group balanced targets (ceil targets to the r
+    biggest retainers, ties by lowest gid) — the ONE ranking both _rebalance
+    and _min_moves use, so the CTRL_MINIMAL oracle and the canonical
+    rebalance can never drift apart. Rank is computed by counting smaller
+    keys, NOT argsort (sort kernels and dynamic gathers serialize on the
+    tiny per-instance axes; one-hot compare-reduce fuses)."""
+    gid = jnp.arange(ng, dtype=I32)
+    k = jnp.sum(member.astype(I32))
+    ksafe = jnp.maximum(k, 1)
+    retained = jnp.sum(owner_oh & valid[:, None], axis=0).astype(I32)  # [NG]
+    q, r = N_SHARDS // ksafe, N_SHARDS % ksafe
+    sort_key = jnp.where(member, (N_SHARDS - retained) * ng + gid, _BIG)
+    rank = jnp.sum(
+        (sort_key[None, :] < sort_key[:, None]).astype(I32), axis=1
+    )  # keys are distinct (gid term), so this IS the sort position
+    target = jnp.where(member, q + (rank < r).astype(I32), 0)
+    return retained, target
+
+
 def _rebalance(ng: int, member, owner, tie_rot, greedy, reshuffle):
-    """The canonical deterministic rebalance (module docstring), plus the two
-    shared planted-bug variants selected by traced flags. Single instance:
-    member [NG] bool, owner [NS] i32 (-1 = unowned); vmap for batching."""
+    """The canonical deterministic rebalance, CLOSED FORM (no sequential
+    fixpoint loop — a 10-pass argmin/argmax loop measured ~45x slower than
+    the kv layer on-chip, pure sequential-depth latency):
+
+      1. invalidate owners that left the member set;
+      2. targets: q = NS//k each, +1 for the first NS%k members ranked by
+         retained load (descending; ties by lowest gid) — giving the ceil
+         targets to the biggest retainers maximizes retention, which is what
+         makes the result minimal-move;
+      3. each member keeps its first ``min(retained, target)`` shards by
+         shard index; every other shard (orphans + overflow) moves;
+      4. moving shards fill member deficits in shard-index order, members
+         ordered by gid — the rotate bug permutes THIS order per replica
+         (the HashMap-iteration analogue): assignments diverge while balance
+         and move count stay invariant.
+
+    Everything is sorts/cumsums over the tiny [NG]/[NS] axes — fixed shallow
+    depth, vmap-friendly. The two planted-bug variants ride traced flags.
+    Single instance: member [NG] bool, owner [NS] i32 (-1 = unowned)."""
     gid = jnp.arange(ng, dtype=I32)
     sid = jnp.arange(N_SHARDS, dtype=I32)
     k = jnp.sum(member.astype(I32))
-    # tie-break key: lowest gid wins ties; the rotate bug permutes the order
-    # per replica, the batched analogue of HashMap iteration order
-    tkey = (gid + tie_rot) % ng
-    valid = (owner >= 0) & jnp.take(member, jnp.clip(owner, 0, ng - 1))
+    ksafe = jnp.maximum(k, 1)
+    owner_oh = owner[:, None] == gid[None, :]  # [NS, NG]; -1 matches nothing
+    valid = jnp.any(owner_oh & member[None, :], axis=1)
     own0 = jnp.where(valid, owner, -1)
+    own0_oh = owner_oh & valid[:, None]
+    retained, target = _retained_targets(ng, member, owner_oh, valid)
+    keep_g = jnp.minimum(retained, target)
+    need_g = target - keep_g  # [NG] >= 0, sums to the moving count
 
-    # --- canonical: NS greedy-minimal passes (each does at most one move)
-    own = own0
-    for _ in range(N_SHARDS):
-        counts = _counts(own, ng)
-        dst = jnp.argmin(jnp.where(member, counts * ng + tkey, _BIG)).astype(I32)
-        src = jnp.argmax(
-            jnp.where(member, counts * ng + (ng - 1 - tkey), -1)
-        ).astype(I32)
-        has_orphan = jnp.any(own < 0) & (k >= 1)
-        orphan_s = jnp.argmax(own < 0)
-        cmax = jnp.max(jnp.where(member, counts, -1))
-        cmin = jnp.min(jnp.where(member, counts, _BIG))
-        unbal = ~has_orphan & (k >= 1) & (cmax - cmin > 1)
-        move_s = jnp.argmax(own == src)
-        tgt_s = jnp.where(has_orphan, orphan_s, move_s)
-        own = jnp.where((sid == tgt_s) & (has_orphan | unbal), dst, own)
+    # keep set (step 3): shard s stays iff its ordinal among its group's
+    # shards (by index) is below keep_g[owner(s)]
+    own_eq = (own0[None, :] == own0[:, None]) & (own0[:, None] >= 0)  # [s, t]
+    ord_s = jnp.sum(own_eq & (sid[None, :] < sid[:, None]), axis=1).astype(I32)
+    keep_lim = jnp.sum(jnp.where(own0_oh, keep_g[None, :], 0), axis=1)
+    keep_s = (own0 >= 0) & (ord_s < keep_lim)
+
+    # assignment (step 4): the m-th moving shard (by index) goes to the
+    # member at the m-th deficit slot, members ordered by (gid + rot) % ng;
+    # slot starts by counting need over smaller rotated keys
+    moving = ~keep_s
+    m_ord = jnp.cumsum(moving.astype(I32)) - moving.astype(I32)  # exclusive
+    akey = jnp.where(member, (gid + tie_rot) % ng, _BIG)
+    start = jnp.sum(
+        jnp.where(akey[None, :] < akey[:, None], need_g[None, :], 0), axis=1
+    )
+    in_slot = (
+        member[None, :]
+        & (m_ord[:, None] >= start[None, :])
+        & (m_ord[:, None] < (start + need_g)[None, :])
+    )
+    dst_s = jnp.sum(jnp.where(in_slot, gid[None, :], 0), axis=1)
+    own = jnp.where(k >= 1, jnp.where(keep_s, own0, dst_s), -1)
 
     # --- bug_greedy_rebalance: all orphans to the single least-loaded member
     # at entry; no balancing pass
-    c0 = _counts(own0, ng)
-    dst0 = jnp.argmin(jnp.where(member, c0 * ng + tkey, _BIG)).astype(I32)
+    gkey = jnp.where(member, retained * ng + akey % ng, _BIG)
+    dst0 = jnp.sum(jnp.where(gkey == jnp.min(gkey), gid, 0))  # keys distinct
     own_greedy = jnp.where((own0 < 0) & (k >= 1), dst0, own0)
 
     # --- bug_full_reshuffle: shard s -> s-th member round-robin (balanced,
-    # retention-blind)
-    order = jnp.argsort(jnp.where(member, tkey, ng + tkey))  # members first
+    # retention-blind); member rank by counting smaller rotated keys
+    mrank = jnp.sum(
+        (member[None, :] & (akey[None, :] < akey[:, None])).astype(I32), axis=1
+    )
+    rs_oh = member[None, :] & (mrank[None, :] == (sid % ksafe)[:, None])
     own_rs = jnp.where(
-        k >= 1, jnp.take(order, sid % jnp.maximum(k, 1)).astype(I32), -1
+        k >= 1, jnp.sum(jnp.where(rs_oh, gid[None, :], 0), axis=1), -1
     )
 
     return jnp.where(reshuffle, own_rs, jnp.where(greedy, own_greedy, own))
@@ -243,26 +297,36 @@ def _min_moves(ng: int, member, owner):
     """Closed-form minimal move count for a membership change: orphans (owner
     not in the new member set) must move, and overloaded members must shed
     down to the best-case targets (the r := NS mod k largest retained loads
-    get ceil targets). Used by the CTRL_MINIMAL oracle; stands down at k=0."""
-    k = jnp.sum(member.astype(I32))
-    valid = (owner >= 0) & jnp.take(member, jnp.clip(owner, 0, ng - 1))
+    get ceil targets — the same rank-by-counting as _rebalance, so this is
+    exactly the canonical spec's move count). Sort- and gather-free. Used by
+    the CTRL_MINIMAL oracle; stands down at k=0."""
+    gid = jnp.arange(ng, dtype=I32)
+    owner_oh = owner[:, None] == gid[None, :]
+    valid = jnp.any(owner_oh & member[None, :], axis=1)
     orphans = jnp.sum((~valid).astype(I32))
-    retained = _counts(jnp.where(valid, owner, -1), ng)
-    ksafe = jnp.maximum(k, 1)
-    q, r = N_SHARDS // ksafe, N_SHARDS % ksafe
-    pos = jnp.arange(ng, dtype=I32)
-    ret_desc = jnp.sort(jnp.where(member, retained, -1))[::-1]
-    target = q + (pos < r).astype(I32)
-    shed = jnp.sum(jnp.where(pos < k, jnp.maximum(ret_desc - target, 0), 0))
+    retained, target = _retained_targets(ng, member, owner_oh, valid)
+    shed = jnp.sum(
+        jnp.where(member, jnp.maximum(retained - target, 0), 0)
+    )
     return orphans + shed
 
 
+_HASH_W = 1000003
+# W^(NS-s) mod 2^32 as wrapping i32 constants: the polynomial hash below is
+# the vectorized form of the Horner fold h = ((bits+1)*W + o_0)*W + o_1 ...
+_HASH_POW = np.array(
+    [pow(_HASH_W, N_SHARDS - s, 1 << 32) for s in range(N_SHARDS + 1)],
+    dtype=np.uint64,
+).astype(np.uint32).view(np.int32)
+
+
 def _hash_config(member, owner, num):
-    """i32 hash of one config (member mask + owner map + its num)."""
+    """i32 hash of one config (member mask + owner map + its num); one
+    multiply-sum instead of a 10-deep sequential fold."""
     bits = member.astype(I32) << jnp.arange(member.shape[0], dtype=I32)
-    h = jnp.sum(bits) + 1
-    for s in range(N_SHARDS):
-        h = h * 1000003 + (owner[..., s] + 2)
+    h = (jnp.sum(bits) + 1) * _HASH_POW[0] + jnp.sum(
+        (owner + 2) * jnp.asarray(_HASH_POW[1:]), axis=-1
+    )
     return h * 31 + num
 
 
@@ -281,25 +345,22 @@ def _apply_entry(kcfg: CtrlerConfig, kkn: CtrlerKnobs, tie_rot,
     client, seq, arg, kind = _unpack(kcfg, val)
     client = jnp.clip(client, 0, kcfg.n_clients - 1)
     is_op = live & (val != NOOP_CMD)
-    prev = jnp.take(last_seq, client)
-    fresh = is_op & (seq > prev)
     cl_oh = jnp.arange(kcfg.n_clients, dtype=I32) == client
+    prev = jnp.sum(jnp.where(cl_oh, last_seq, 0))  # one-hot, not a gather
+    fresh = is_op & (seq > prev)
     last_seq = jnp.where(cl_oh & is_op, jnp.maximum(prev, seq), last_seq)
 
     room = cfg_num < ncfg - 1
     gid_arg = jnp.clip(arg % ng, 0, ng - 1)
     mv_shard = jnp.clip(arg // ng, 0, N_SHARDS - 1)
     mv_gid = gid_arg
+    g_oh = jnp.arange(ng, dtype=I32) == gid_arg
+    mem_at_arg = jnp.any(g_oh & member)
 
-    do_join = fresh & (kind == _JOIN) & room & ~jnp.take(member, gid_arg)
-    do_leave = fresh & (kind == _LEAVE) & room & jnp.take(member, gid_arg)
-    new_member = jnp.where(
-        jnp.arange(ng, dtype=I32) == gid_arg,
-        (member | do_join) & ~do_leave, member,
-    )
-    do_move = (
-        fresh & (kind == _MOVE) & room & jnp.take(member, mv_gid)
-    )
+    do_join = fresh & (kind == _JOIN) & room & ~mem_at_arg
+    do_leave = fresh & (kind == _LEAVE) & room & mem_at_arg
+    new_member = jnp.where(g_oh, (member | do_join) & ~do_leave, member)
+    do_move = fresh & (kind == _MOVE) & room & mem_at_arg
     do_rebal = do_join | do_leave
 
     reb = _rebalance(ng, new_member, owner, tie_rot,
@@ -314,9 +375,8 @@ def _apply_entry(kcfg: CtrlerConfig, kkn: CtrlerKnobs, tie_rot,
     # --- balance + minimality oracles on Join/Leave transitions (k >= 1)
     k2 = jnp.sum(new_member.astype(I32))
     cnt2 = _counts(new_owner, ng)
-    owners_ok = jnp.all(
-        (new_owner >= 0) & jnp.take(new_member, jnp.clip(new_owner, 0, ng - 1))
-    )
+    no_oh = new_owner[:, None] == jnp.arange(ng, dtype=I32)[None, :]
+    owners_ok = jnp.all(jnp.any(no_oh & new_member[None, :], axis=1))
     cmax = jnp.max(jnp.where(new_member, cnt2, -1))
     cmin = jnp.min(jnp.where(new_member, cnt2, _BIG))
     bal_bad = do_rebal & (k2 >= 1) & (~owners_ok | (cmax - cmin > 1))
@@ -339,9 +399,10 @@ def _apply_entry(kcfg: CtrlerConfig, kkn: CtrlerKnobs, tie_rot,
     # the "no reply yet" sentinel in clerk_q_obs / w_q_obs).
     is_q = fresh & (kind == _QUERY)
     eff = jnp.minimum(arg, cfg_num2)
-    q_obs = jnp.where(
-        is_q, jnp.take(hist, jnp.clip(eff, 0, ncfg - 1)) & 0x7FFFFFFF, -1
-    )
+    hist_at = jnp.sum(
+        jnp.where(jnp.arange(ncfg, dtype=I32) == eff, hist, 0)
+    )  # one-hot read, not a gather
+    q_obs = jnp.where(is_q, hist_at & 0x7FFFFFFF, -1)
 
     return member, owner, hist, cfg_num2, last_seq, fresh, client, seq, q_obs, viol
 
@@ -377,7 +438,6 @@ class CtrlerState(NamedTuple):
     w_owner: jax.Array      # i32 [NS]
     w_cfg_num: jax.Array    # i32
     w_hist: jax.Array       # i32 [NCFG]
-    w_acked: jax.Array      # i32 [NC] walker-accepted seq per client
     w_q_seq: jax.Array      # i32 [NC] seq of the walker's last Query per client
     w_q_obs: jax.Array      # i32 [NC] the walker's answer for it
 
@@ -426,7 +486,6 @@ def init_ctrler_cluster(
         w_owner=jnp.full((N_SHARDS,), -1, I32),
         w_cfg_num=jnp.asarray(0, I32),
         w_hist=hist0,
-        w_acked=jnp.zeros((nc,), I32),
         w_q_seq=jnp.zeros((nc,), I32),
         w_q_obs=jnp.full((nc,), -1, I32),
     )
@@ -546,7 +605,7 @@ def ctrler_step(
     w_frontier, w_last_seq = ks.w_frontier, ks.w_last_seq
     w_member, w_owner = ks.w_member, ks.w_owner
     w_cfg_num, w_hist = ks.w_cfg_num, ks.w_hist
-    w_acked, w_q_seq, w_q_obs = ks.w_acked, ks.w_q_seq, ks.w_q_obs
+    w_q_seq, w_q_obs = ks.w_q_seq, ks.w_q_obs
     sh_abs = _lane_abs(s.shadow_base, cap)  # [cap]
     lane1 = jnp.arange(cap, dtype=I32)
     for _ in range(kcfg.walk_max):
@@ -561,7 +620,6 @@ def ctrler_step(
             w_cfg_num, w_last_seq, val, canw)
         viol |= v
         cl_oh = cl_ids == client
-        w_acked = jnp.maximum(w_acked, jnp.where(cl_oh & fresh, seq, 0))
         hit_q = cl_oh & fresh & (q_obs >= 0)
         w_q_seq = jnp.where(hit_q, seq, w_q_seq)
         w_q_obs = jnp.where(hit_q, q_obs, w_q_obs)
@@ -602,7 +660,7 @@ def ctrler_step(
     queries_done = ks.queries_done + done_q.astype(I32)
 
     # start fresh ops / retry pending ones
-    kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 4)
+    kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 5)
     start = (
         ~clerk_out
         & jax.random.bernoulli(kk[0], ckn.p_op, (nc,))
@@ -622,16 +680,19 @@ def ctrler_step(
             ),
         ),
     )
-    # arg draws: gid for Join/Leave; (shard, gid) for Move; num (incl. the
-    # "latest" sentinel ARG_LIM-1) for Query — one randint reduced per kind
+    # arg draws: gid for Join/Leave, (shard, gid) for Move from one randint;
+    # the Query num from its OWN randint over the full history range —
+    # deriving it from the Move-sized draw would truncate historical-query
+    # coverage whenever N_SHARDS*n_gids < n_configs+1 (small gid universes)
     raw = jax.random.randint(
         kk[1], (nc,), 0, N_SHARDS * kcfg.n_gids, dtype=I32
     )
+    qnum = jax.random.randint(kk[4], (nc,), 0, kcfg.n_configs + 1, dtype=I32)
     new_arg = jnp.where(
         new_kind == _QUERY,
         jnp.where(
             raw % 4 == 0, kcfg._arg_lim - 1,  # "latest" 25% of the time
-            raw % (kcfg.n_configs + 1),
+            qnum,
         ),
         jnp.where(new_kind == _MOVE, raw, raw % kcfg.n_gids),
     )
@@ -703,7 +764,6 @@ def ctrler_step(
         w_owner=w_owner,
         w_cfg_num=w_cfg_num,
         w_hist=w_hist,
-        w_acked=w_acked,
         w_q_seq=w_q_seq,
         w_q_obs=w_q_obs,
     )

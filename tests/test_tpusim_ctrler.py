@@ -52,25 +52,37 @@ NG = CT.n_gids
 
 # ------------------------------------------------------ numpy reference model
 def ref_rebalance(member, owner):
-    """Independent model of the canonical rebalance (module docstring of
-    ctrler.py): orphans to the least-loaded member (ties: lowest gid), then
-    one max->min move at a time until max-min <= 1."""
+    """Independent model of the canonical closed-form rebalance (ctrler.py
+    _rebalance docstring): ceil targets to the biggest retainers (ties by
+    lowest gid); each member keeps its first target-many shards by index;
+    moving shards fill deficits in shard-index order, members by gid."""
     ng = len(member)
+    ns = len(owner)
     own = [g if (0 <= g < ng and member[g]) else -1 for g in owner]
     memb = [g for g in range(ng) if member[g]]
     if not memb:
-        return [-1] * len(owner)
-    for _ in range(len(owner)):
-        counts = {g: sum(1 for x in own if x == g) for g in memb}
-        dst = min(memb, key=lambda g: (counts[g], g))
-        src = max(memb, key=lambda g: (counts[g], -g))
-        if -1 in own:
-            own[own.index(-1)] = dst
-        elif counts[src] - counts[dst] > 1:
-            own[own.index(src)] = dst
+        return [-1] * ns
+    k = len(memb)
+    q, r = divmod(ns, k)
+    retained = {g: sum(1 for x in own if x == g) for g in memb}
+    by_load = sorted(memb, key=lambda g: (-retained[g], g))
+    target = {g: q + (1 if i < r else 0) for i, g in enumerate(by_load)}
+    kept = {g: 0 for g in memb}
+    out = []
+    moving = []
+    for s, g in enumerate(own):
+        if g >= 0 and kept[g] < target[g]:
+            kept[g] += 1
+            out.append(g)
         else:
-            break
-    return own
+            moving.append(s)
+            out.append(None)
+    slots = []
+    for g in sorted(memb):  # assignment order: gid ascending (rot = 0)
+        slots += [g] * (target[g] - kept[g])
+    for s, g in zip(moving, slots):
+        out[s] = g
+    return out
 
 
 def ref_min_moves(member, owner):
