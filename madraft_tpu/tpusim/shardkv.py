@@ -14,7 +14,9 @@ tests.rs:438-493) and keep serving unaffected shards mid-migration
   pre-drawn **config schedule tensor** (activation tick + shard->group map per
   config), the batched analogue of the reference's ctrler service whose
   content the tests fully script anyway (join/leave calls). Correctness of
-  the *controller itself* is covered by the C++ backend's 4A suite.
+  the *controller itself* is fuzzed separately: on-device by ``ctrler.py``
+  (the 4A service as a replicated state machine with balance / minimality /
+  determinism / query_at oracles) and on the C++ backend by its 4A suite.
 - Config adoption, shard install, and shard deletion all ride each group's
   raft log as marker entries (CONFIG/INSTALL/DELETE), so crash-restart
   recovery and duplicate suppression work exactly like client ops — the
