@@ -14,6 +14,8 @@
 
 #include <algorithm>
 #include <array>
+#include <cstdlib>
+#include <cstring>
 #include <optional>
 
 #include "../kvraft/rsm.h"
@@ -31,6 +33,40 @@ using simcore::Task;
 constexpr size_t N_SHARDS = 10;  // mod.rs:9
 using Gid = uint64_t;
 constexpr uint64_t LATEST = ~0ull;  // Query{u64::MAX} = latest (client.rs:17)
+
+// Deliberate-bug injection for the TPU<->C++ differential bridge, mirroring
+// the batched backend's 4A planted bugs (madraft_tpu/tpusim/ctrler.py): the
+// TPU fuzzer finds a violation under one of its rebalance bug modes; the C++
+// replay (cpp/tools/ctrler_replay_core.h) runs the SAME protocol bug so the
+// violation class must reproduce. Env-gated so the production path is
+// untouched. Name table shared with the replay parser via bug_mode_of.
+//   MADTPU_CTRLER_BUG=rotate_tiebreak   — tie-break order rotated by
+//       MADTPU_CTRLER_ROT (per-replica): replicas diverge, the
+//       HashMap-iteration-order classic the reference README warns about
+//   MADTPU_CTRLER_BUG=greedy_rebalance  — all orphans to the one
+//       least-loaded group, no balancing pass (balance breaks)
+//   MADTPU_CTRLER_BUG=full_reshuffle    — balanced round-robin reassignment
+//       ignoring retention (minimality breaks)
+inline int ctrl_bug_mode_of(const char* name) {
+  if (!name) return 0;
+  if (!std::strcmp(name, "rotate_tiebreak")) return 1;
+  if (!std::strcmp(name, "greedy_rebalance")) return 2;
+  if (!std::strcmp(name, "full_reshuffle")) return 3;
+  return 0;
+}
+
+inline bool is_known_ctrler_bug(const std::string& name) {
+  return name == "none" || ctrl_bug_mode_of(name.c_str()) != 0;
+}
+
+inline int ctrl_bug_mode() {  // per call, not cached (capi multi-replay)
+  return ctrl_bug_mode_of(std::getenv("MADTPU_CTRLER_BUG"));
+}
+
+inline uint64_t ctrl_rot() {
+  const char* e = std::getenv("MADTPU_CTRLER_ROT");
+  return e ? uint64_t(std::strtoull(e, nullptr, 10)) : 0;
+}
 
 struct Config {
   uint64_t num = 0;
@@ -167,6 +203,13 @@ struct ShardInfo {
     size_t ngroups = c.groups.size();
     size_t base = N_SHARDS / ngroups;
     size_t extra = N_SHARDS % ngroups;
+    int bug = ctrl_bug_mode();
+    // rotate_tiebreak: gid tie-breaks compare (gid + rot) mod (max gid + 1)
+    // instead of gid — a per-replica permutation of the iteration order, the
+    // batched backend's bug_rotate_tiebreak (ctrler.py). rot=0 = canonical.
+    uint64_t rot = bug == 1 ? ctrl_rot() : 0;
+    uint64_t mod = c.groups.rbegin()->first + 1;
+    auto rkey = [&](Gid g) { return (g + rot) % mod; };
 
     std::map<Gid, size_t> count;
     for (auto& [gid, _] : c.groups) count[gid] = 0;
@@ -178,8 +221,34 @@ struct ShardInfo {
         it->second++;
     }
 
+    if (bug == 2) {
+      // greedy_rebalance: every orphan to the single least-loaded group at
+      // entry, no balancing pass (ctrler.py bug_greedy_rebalance)
+      Gid dst = 0;
+      size_t best = N_SHARDS + 1;
+      for (auto& [gid, n] : count)
+        if (n < best) best = n, dst = gid;
+      for (size_t s = 0; s < N_SHARDS; s++)
+        if (c.shards[s] == 0) c.shards[s] = dst;
+      return;
+    }
+    if (bug == 3) {
+      // full_reshuffle: shard s -> s-th member round-robin, retention-blind
+      // (ctrler.py bug_full_reshuffle)
+      std::vector<Gid> memb;
+      for (auto& [gid, _] : c.groups) memb.push_back(gid);
+      for (size_t s = 0; s < N_SHARDS; s++)
+        c.shards[s] = memb[s % memb.size()];
+      return;
+    }
+
     // pick which groups get base+1: the currently-largest (fewest moves),
-    // ties broken by ascending gid for cross-replica determinism
+    // ties broken by ascending gid for cross-replica determinism. NOT
+    // rotated under the planted bug: the TPU bug_rotate_tiebreak rotates
+    // only the deficit-fill order (ctrler.py _rebalance step 4; its
+    // _retained_targets ranking stays canonical), which keeps balance and
+    // the move COUNT replica-invariant while the assignment diverges —
+    // the C++ mirror must do the same.
     std::vector<std::pair<Gid, size_t>> order(count.begin(), count.end());
     std::stable_sort(order.begin(), order.end(),
                      [](const auto& a, const auto& b) {
@@ -204,10 +273,14 @@ struct ShardInfo {
     }
     std::sort(orphans.begin(), orphans.end());
 
-    // fill deficits in ascending gid order
+    // fill deficits in ascending gid order (rotated under the planted bug)
+    std::vector<Gid> fill_order;
+    for (auto& [gid, _] : target) fill_order.push_back(gid);
+    std::sort(fill_order.begin(), fill_order.end(),
+              [&](Gid a, Gid b) { return rkey(a) < rkey(b); });
     size_t oi = 0;
-    for (auto& [gid, tgt] : target) {
-      while (count[gid] < tgt) {
+    for (Gid gid : fill_order) {
+      while (count[gid] < target[gid]) {
         c.shards[orphans[oi++]] = gid;
         count[gid]++;
       }

@@ -5,6 +5,7 @@
 //
 //   int madtpu_replay_run(const char* schedule, char* out, int cap);
 //   int madtpu_shardkv_replay_run(const char* schedule, char* out, int cap);
+//   int madtpu_ctrler_replay_run(const char* schedule, char* out, int cap);
 //   int madtpu_lincheck_run(const char* history);
 //
 // The replay entry points take the SAME schedule text the CLI binaries
@@ -24,6 +25,7 @@
 #include <cstring>
 #include <mutex>
 
+#include "ctrler_replay_core.h"
 #include "lincheck_core.h"
 #include "replay_core.h"
 #include "shardkv_replay_core.h"
@@ -64,6 +66,17 @@ int madtpu_shardkv_replay_run(const char* schedule, char* out, int cap) {
   if (!ok || sch.groups > madtpu_shardkv_replay::ShardKvTester::N_GROUPS)
     return -1;
   return emit(madtpu_shardkv_replay::run_schedule(sch), out, cap);
+}
+
+int madtpu_ctrler_replay_run(const char* schedule, char* out, int cap) {
+  std::lock_guard<std::mutex> lock(g_call_mutex);
+  FILE* f = fmemopen((void*)schedule, std::strlen(schedule), "r");
+  if (!f) return -1;
+  madtpu_ctrler_replay::Schedule sch;
+  bool ok = madtpu_ctrler_replay::parse_schedule(f, &sch);
+  std::fclose(f);
+  if (!ok) return -1;
+  return emit(madtpu_ctrler_replay::run_schedule(sch), out, cap);
 }
 
 int madtpu_lincheck_run(const char* history) {

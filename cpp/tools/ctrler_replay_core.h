@@ -1,0 +1,225 @@
+// madtpu_ctrler_replay core — the simcore end of the Lab-4A differential
+// bridge (madraft_tpu/bridge.py extract_ctrler_schedule). Shared between the
+// CLI binary (ctrler_replay_main.cpp) and the in-process C API (capi.cpp).
+//
+// The batched TPU 4A fuzzer (madraft_tpu/tpusim/ctrler.py) commits
+// Join/Leave/Move/Query ops through a raft cluster and checks balance,
+// minimal transfers, replica determinism, and historical queries on device.
+// The Python exporter replays one (seed, cluster), walks its committed
+// shadow log, dedups clerk retries, filters to the EFFECTIVE ops (the ones
+// the service actually applied — both backends reject a Join of a member, a
+// Leave of a non-member, a Move to a non-member, and any mutation past the
+// TPU history capacity), and ships them here. This tool applies the stream
+// to the REAL ShardInfo state machine (cpp/shard_ctrler/ctrler.h) with the
+// SAME planted bug enabled (ctrl_bug_mode) and reports which violation
+// classes its own checkers observed:
+//   balance_bad  — a Join/Leave config is unbalanced or orphans a shard
+//                  (ctrler_tester.h's check; TPU CTRL_BALANCE)
+//   minimal_bad  — a Join/Leave moved more shards than the closed-form
+//                  minimum (TPU CTRL_MINIMAL)
+//   diverged     — two replicas with rotated tie-breaks disagree on the
+//                  config history (TPU CTRL_DIVERGE / CTRL_QUERY)
+//   map_match    — bug-free runs only: the final owner map and config count
+//                  equal the TPU walker's EXACTLY (both backends implement
+//                  the same canonical rebalance spec; gid g <-> Gid g+1)
+//
+// Schedule format (line-based; '#' comments):
+//   gids <NG>
+//   bug <none|rotate_tiebreak|greedy_rebalance|full_reshuffle>
+//   op join <gid> | op leave <gid> | op move <shard> <gid> | op query <num>
+//   expect_cfgs <n>
+//   expect_owner <o0> ... <o9>       # -1 = unowned (TPU gid index space)
+#pragma once
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "../shard_ctrler/ctrler.h"
+#include "env_guard.h"
+
+namespace madtpu_ctrler_replay {
+
+using shard_ctrler::Config;
+using shard_ctrler::CtrlOp;
+using shard_ctrler::Gid;
+using shard_ctrler::N_SHARDS;
+using shard_ctrler::ShardInfo;
+
+struct OpLine {
+  int kind = 0;  // 0 join(a) / 1 leave(a) / 2 move(a=shard, b=gid) / 3 query(a=num)
+  uint64_t a = 0, b = 0;
+};
+
+struct Schedule {
+  uint64_t gids = 5;
+  std::string bug = "none";
+  std::vector<OpLine> ops;
+  long long expect_cfgs = -1;
+  std::vector<long long> expect_owner;  // -1 = unowned
+};
+
+inline bool parse_schedule(FILE* f, Schedule* out) {
+  char line[512];
+  while (std::fgets(line, sizeof line, f)) {
+    if (line[0] == '#' || line[0] == '\n') continue;
+    char kw[32] = {0};
+    if (std::sscanf(line, "%31s", kw) != 1) continue;
+    if (!std::strcmp(kw, "gids")) {
+      std::sscanf(line, "%*s %" SCNu64, &out->gids);
+    } else if (!std::strcmp(kw, "bug")) {
+      char b[64] = {0};
+      if (std::sscanf(line, "%*s %63s", b) == 1) out->bug = b;
+      // reject unknown names — a silently-skipped bug would make a clean
+      // replay read as "TPU false positive" (same guard as the other legs)
+      if (!shard_ctrler::is_known_ctrler_bug(out->bug)) return false;
+    } else if (!std::strcmp(kw, "op")) {
+      char k[32] = {0};
+      OpLine op;
+      int got = std::sscanf(line, "%*s %31s %" SCNu64 " %" SCNu64, k, &op.a,
+                            &op.b);
+      if (got < 2) return false;
+      if (!std::strcmp(k, "join")) op.kind = 0;
+      else if (!std::strcmp(k, "leave")) op.kind = 1;
+      else if (!std::strcmp(k, "move")) op.kind = 2;
+      else if (!std::strcmp(k, "query")) op.kind = 3;
+      else return false;
+      // a truncated "op move <shard>" would silently replay move(_, gid 0)
+      // — a different op stream reading as "TPU false positive"
+      if (op.kind == 2 && got < 3) return false;
+      out->ops.push_back(op);
+    } else if (!std::strcmp(kw, "expect_cfgs")) {
+      std::sscanf(line, "%*s %lld", &out->expect_cfgs);
+    } else if (!std::strcmp(kw, "expect_owner")) {
+      const char* p = line + std::strlen("expect_owner");
+      char* end = nullptr;
+      for (size_t s = 0; s < N_SHARDS; s++) {
+        long long v = std::strtoll(p, &end, 10);
+        if (end == p) return false;
+        out->expect_owner.push_back(v);
+        p = end;
+      }
+    }
+  }
+  return true;
+}
+
+// The closed-form minimal move count for old config -> new member set:
+// orphans must move; overloaded members shed down to best-case targets
+// (ceil targets to the largest retained loads, ties by ascending gid) —
+// the same formula as ctrler.py _min_moves.
+inline size_t min_moves(const Config& before,
+                        const std::map<Gid, std::vector<simcore::Addr>>& groups) {
+  std::map<Gid, size_t> retained;
+  for (auto& [gid, _] : groups) retained[gid] = 0;
+  size_t orphans = 0;
+  for (size_t s = 0; s < N_SHARDS; s++) {
+    auto it = retained.find(before.shards[s]);
+    if (it == retained.end())
+      orphans++;
+    else
+      it->second++;
+  }
+  size_t k = groups.size();
+  if (!k) return 0;
+  size_t q = N_SHARDS / k, r = N_SHARDS % k;
+  std::vector<std::pair<Gid, size_t>> order(retained.begin(), retained.end());
+  std::stable_sort(order.begin(), order.end(),
+                   [](const auto& a, const auto& b) {
+                     return a.second != b.second ? a.second > b.second
+                                                 : a.first < b.first;
+                   });
+  size_t shed = 0;
+  for (size_t i = 0; i < order.size(); i++) {
+    size_t tgt = q + (i < r ? 1 : 0);
+    if (order[i].second > tgt) shed += order[i].second - tgt;
+  }
+  return orphans + shed;
+}
+
+inline std::string run_schedule(const Schedule& sch) {
+  madtpu_tools::EnvGuard bug_guard(
+      "MADTPU_CTRLER_BUG", sch.bug == "none" ? nullptr : sch.bug.c_str());
+  bool rotate = sch.bug == "rotate_tiebreak";
+
+  ShardInfo a, b;  // b: the rot=1 replica, used for the divergence class
+  int balance_bad = 0, minimal_bad = 0;
+  for (const auto& op : sch.ops) {
+    CtrlOp c;
+    switch (op.kind) {
+      case 0:
+        c = CtrlOp::join({{Gid(op.a) + 1, {simcore::Addr(op.a + 1)}}});
+        break;
+      case 1:
+        c = CtrlOp::leave({Gid(op.a) + 1});
+        break;
+      case 2:
+        c = CtrlOp::move_(op.a, Gid(op.b) + 1);
+        break;
+      default:
+        c = CtrlOp::query(op.a);
+        break;
+    }
+    Config before = a.configs.back();
+    {
+      madtpu_tools::EnvGuard rg("MADTPU_CTRLER_ROT", "0");
+      a.apply(c);
+    }
+    if (rotate) {
+      madtpu_tools::EnvGuard rg("MADTPU_CTRLER_ROT", "1");
+      b.apply(c);
+    }
+    if (op.kind == 0 || op.kind == 1) {
+      const Config& now = a.configs.back();
+      if (now.groups.empty()) continue;  // checks stand down at k = 0
+      // balance: every shard on a member; loads max-min <= 1
+      // (shard_ctrler/ctrler_tester.h's check())
+      std::map<Gid, size_t> count;
+      for (auto& [gid, _] : now.groups) count[gid] = 0;
+      bool orphan = false;
+      for (size_t s = 0; s < N_SHARDS; s++) {
+        auto it = count.find(now.shards[s]);
+        if (it == count.end())
+          orphan = true;
+        else
+          it->second++;
+      }
+      size_t cmax = 0, cmin = N_SHARDS;
+      for (auto& [_, n] : count) {
+        cmax = std::max(cmax, n);
+        cmin = std::min(cmin, n);
+      }
+      if (orphan || cmax - cmin > 1) balance_bad++;
+      // minimality vs the closed form
+      size_t moved = 0;
+      for (size_t s = 0; s < N_SHARDS; s++)
+        if (before.shards[s] != now.shards[s]) moved++;
+      if (moved != min_moves(before, now.groups)) minimal_bad++;
+    }
+  }
+  int diverged = rotate && !(a.configs == b.configs) ? 1 : 0;
+  int map_match = -1;  // -1 = not checked (bug runs have no expected map)
+  if (sch.bug == "none" && sch.expect_owner.size() == N_SHARDS) {
+    const Config& fin = a.configs.back();
+    map_match = 1;
+    for (size_t s = 0; s < N_SHARDS; s++) {
+      long long want = sch.expect_owner[s];
+      Gid got = fin.shards[s];
+      if (want < 0 ? got != 0 : got != Gid(want) + 1) map_match = 0;
+    }
+    if (sch.expect_cfgs >= 0 && (long long)fin.num != sch.expect_cfgs)
+      map_match = 0;
+  }
+  char buf[256];
+  std::snprintf(buf, sizeof buf,
+                "{\"balance_bad\": %d, \"minimal_bad\": %d, \"diverged\": %d, "
+                "\"map_match\": %d, \"configs\": %llu}",
+                balance_bad, minimal_bad, diverged, map_match,
+                (unsigned long long)a.configs.back().num);
+  return buf;
+}
+
+}  // namespace madtpu_ctrler_replay

@@ -1,0 +1,21 @@
+// madtpu_ctrler_replay — CLI front of the Lab-4A differential bridge.
+// See ctrler_replay_core.h for the schedule format and checker semantics.
+// Output: one JSON line; exit 0 if the replay ran, 2 on a bad schedule.
+#include "ctrler_replay_core.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <schedule-file>\n", argv[0]);
+    return 2;
+  }
+  FILE* f = std::fopen(argv[1], "r");
+  madtpu_ctrler_replay::Schedule sch;
+  bool ok = f && madtpu_ctrler_replay::parse_schedule(f, &sch);
+  if (f) std::fclose(f);
+  if (!ok) {
+    std::fprintf(stderr, "bad schedule file: %s\n", argv[1]);
+    return 2;
+  }
+  std::puts(madtpu_ctrler_replay::run_schedule(sch).c_str());
+  return 0;
+}

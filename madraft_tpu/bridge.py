@@ -503,3 +503,211 @@ def shardkv_classes_match(tpu_violations: int, cpp_report: dict) -> bool:
     if tpu_violations & VIOLATION_SHARD_STALE_READ and cpp_report["stale_read"]:
         return True
     return False
+
+
+# ---------------------------------------------------------------- ctrler leg
+@dataclasses.dataclass
+class CtrlerSchedule:
+    """One 4A cluster's COMMITTED OP STREAM for the C++ replayer
+    (cpp/tools/ctrler_replay_core.h). Unlike the raft/shardkv legs, the
+    interchange is not a fault schedule but the deduplicated, effective op
+    sequence the replicated config service applied — the service state
+    machine is deterministic, so the C++ ShardInfo must reproduce the TPU
+    walker's exact config history from it (gid g <-> C++ Gid g+1). Planted
+    rebalance bugs ride by name, exactly as the other legs."""
+
+    n_gids: int
+    bug: str = "none"  # none | rotate_tiebreak | greedy_rebalance | full_reshuffle
+    ops: list = dataclasses.field(default_factory=list)
+    # ("join", g) | ("leave", g) | ("move", shard, g) | ("query", num)
+    expect_cfgs: int = -1
+    expect_owner: list = dataclasses.field(default_factory=list)
+    violations: int = 0
+    first_violation_tick: int = -1
+
+    def dumps(self) -> str:
+        lines = [
+            "# madtpu 4A differential-replay schedule (bridge.py)",
+            f"gids {self.n_gids}",
+            f"bug {self.bug}",
+        ]
+        for op in self.ops:
+            lines.append("op " + " ".join(str(x) for x in op))
+        if self.expect_cfgs >= 0:
+            lines.append(f"expect_cfgs {self.expect_cfgs}")
+        if self.expect_owner:
+            lines.append(
+                "expect_owner " + " ".join(str(o) for o in self.expect_owner)
+            )
+        return "\n".join(lines) + "\n"
+
+
+def extract_ctrler_schedule(cfg, kcfg, seed: int, cluster_id: int,
+                            n_ticks: int) -> CtrlerSchedule:
+    """Re-run ONE 4A cluster, stream its committed shadow log, and reduce it
+    to the effective op sequence (dedup clerk retries; drop the ops both
+    backends reject — Join of a member, Leave of a non-member, Move to a
+    non-member, any mutation past the history capacity). For bug-free runs
+    the canonical model (the REAL ``_rebalance``) also yields the expected
+    final owner map, cross-checked against the TPU walker before export."""
+    from madraft_tpu.tpusim.config import NOOP_CMD
+    from madraft_tpu.tpusim.ctrler import (
+        N_SHARDS,
+        _rebalance,
+        _unpack,
+        ctrler_step,
+        init_ctrler_cluster,
+    )
+    from madraft_tpu.tpusim.state import I32
+
+    ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
+
+    @jax.jit
+    def run(key):
+        def body(carry, _):
+            nxt = ctrler_step(cfg, kcfg, carry, key)
+            return nxt, (nxt.raft.shadow_len, nxt.raft.shadow_base,
+                         nxt.raft.shadow_val)
+
+        return jax.lax.scan(
+            body, init_ctrler_cluster(cfg, kcfg, key), None, length=n_ticks
+        )
+
+    final, (lens, bases, vals) = jax.block_until_ready(run(ckey))
+    lens, bases, vals = np.asarray(lens), np.asarray(bases), np.asarray(vals)
+    cap = cfg.log_cap
+
+    stream = []
+    prev = 0
+    for t in range(n_ticks):
+        ln = int(lens[t])
+        for ab in range(prev + 1, ln + 1):
+            assert ab > int(bases[t]), (
+                "shadow window outran the export walk — commit burst > log_cap"
+            )
+            stream.append(int(vals[t, int(_slot(ab, cap))]))
+        prev = ln
+
+    ng = kcfg.n_gids
+    off, rot0 = jnp.bool_(False), jnp.asarray(0, I32)
+
+    def rebal(member, owner):
+        # np.array (copy): the Move branch writes into the result, and a
+        # zero-copy view of a jax array is read-only
+        return np.array(_rebalance(
+            ng, jnp.asarray(member), jnp.asarray(owner, I32), rot0, off, off
+        ))
+
+    member = np.zeros(ng, bool)
+    owner = np.full(N_SHARDS, -1, np.int64)
+    cfgs = 0
+    last_seq: dict = {}
+    sched = CtrlerSchedule(
+        n_gids=ng,
+        bug=(
+            "rotate_tiebreak" if kcfg.bug_rotate_tiebreak
+            else "greedy_rebalance" if kcfg.bug_greedy_rebalance
+            else "full_reshuffle" if kcfg.bug_full_reshuffle
+            else "none"
+        ),
+        violations=int(final.raft.violations),
+        first_violation_tick=int(final.raft.first_violation_tick),
+    )
+    for v in stream:
+        if v == 0 or v == NOOP_CMD:
+            continue
+        client, seq, arg, kind = _unpack(kcfg, v)
+        if seq <= last_seq.get(client, 0):
+            continue
+        last_seq[client] = seq
+        room = cfgs < kcfg.n_configs - 1
+        if kind == 0:  # Join
+            gid = arg % ng
+            if room and not member[gid]:
+                member[gid] = True
+                owner = rebal(member, owner)
+                cfgs += 1
+                sched.ops.append(("join", gid))
+        elif kind == 1:  # Leave
+            gid = arg % ng
+            if room and member[gid]:
+                member[gid] = False
+                owner = rebal(member, owner)
+                cfgs += 1
+                sched.ops.append(("leave", gid))
+        elif kind == 2:  # Move
+            shard, gid = arg // ng, arg % ng
+            if room and member[gid]:
+                owner[shard] = gid
+                cfgs += 1
+                sched.ops.append(("move", shard, gid))
+        else:  # Query: num beyond the history means "latest" on both sides
+            sched.ops.append(("query", arg))
+    if sched.bug == "none":
+        # internal consistency gate: the canonical model must agree with the
+        # TPU walker before we assert anything about the C++ side
+        w_owner = np.asarray(final.w_owner)
+        w_cfgs = int(final.w_cfg_num)
+        assert cfgs == w_cfgs and (owner == w_owner).all(), (
+            f"exporter model diverged from the TPU walker: "
+            f"{cfgs}/{owner.tolist()} vs {w_cfgs}/{w_owner.tolist()}"
+        )
+        sched.expect_cfgs = cfgs
+        sched.expect_owner = [int(o) for o in owner]
+    return sched
+
+
+def replay_ctrler_on_simcore(
+    schedule: CtrlerSchedule,
+    binary: Optional[pathlib.Path] = None,
+    workdir: Optional[pathlib.Path] = None,
+) -> dict:
+    """Apply a 4A op schedule to the real C++ ShardInfo; returns its JSON
+    report. In-process by default; ``binary`` forces the CLI subprocess."""
+    if binary is None:
+        from madraft_tpu import simcore
+
+        if simcore.available():
+            return simcore.replay_ctrler_schedule(schedule.dumps())
+    binary = pathlib.Path(binary or _REPO / "build" / "madtpu_ctrler_replay")
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".txt", prefix="madtpu_ctl_replay_",
+        dir=str(workdir) if workdir else None, delete=False,
+    ) as f:
+        f.write(schedule.dumps())
+        path = f.name
+    try:
+        proc = subprocess.run(
+            [str(binary), path], capture_output=True, text=True, timeout=300
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"ctrler replay failed rc={proc.returncode}: "
+                f"{proc.stderr[-2000:]}"
+            )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    finally:
+        os.unlink(path)
+
+
+def ctrler_classes_match(tpu_violations: int, cpp_report: dict) -> bool:
+    """Class map for the 4A service: balance/minimality transfer directly;
+    the TPU divergence AND historical-query bits both stem from
+    replica-divergent rebalance, which the C++ side reproduces as two
+    rotated ShardInfo replicas disagreeing on the config history."""
+    from madraft_tpu.tpusim.ctrler import (
+        VIOLATION_CTRL_BALANCE,
+        VIOLATION_CTRL_DIVERGE,
+        VIOLATION_CTRL_MINIMAL,
+        VIOLATION_CTRL_QUERY,
+    )
+
+    if tpu_violations & VIOLATION_CTRL_BALANCE and cpp_report["balance_bad"]:
+        return True
+    if tpu_violations & VIOLATION_CTRL_MINIMAL and cpp_report["minimal_bad"]:
+        return True
+    if tpu_violations & (VIOLATION_CTRL_DIVERGE | VIOLATION_CTRL_QUERY) and (
+        cpp_report["diverged"]
+    ):
+        return True
+    return False

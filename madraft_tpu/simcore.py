@@ -64,7 +64,8 @@ def load(build_if_missing: bool = True) -> ctypes.CDLL:
     ):
         _build_lib()
     lib = ctypes.CDLL(str(_LIB_PATH))
-    for name in ("madtpu_replay_run", "madtpu_shardkv_replay_run"):
+    for name in ("madtpu_replay_run", "madtpu_shardkv_replay_run",
+                 "madtpu_ctrler_replay_run"):
         fn = getattr(lib, name)
         fn.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
         fn.restype = ctypes.c_int
@@ -107,6 +108,13 @@ def replay_shardkv_schedule(schedule_text: str) -> dict:
     (same schema as the madtpu_shardkv_replay CLI). The bug mode rides in
     the schedule text and is restored after the run."""
     return _run("madtpu_shardkv_replay_run", schedule_text)
+
+
+def replay_ctrler_schedule(schedule_text: str) -> dict:
+    """Apply a 4A committed-op schedule to the real ShardInfo state machine
+    in process -> JSON report (same schema as the madtpu_ctrler_replay CLI).
+    The planted-bug name rides in the schedule text and is restored after."""
+    return _run("madtpu_ctrler_replay_run", schedule_text)
 
 
 def check_linearizable(history_text: str) -> bool:

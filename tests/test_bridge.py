@@ -272,3 +272,83 @@ def test_kv_put_histories_cross_validated_by_wing_gong():
         assert bridge.check_history_on_simcore(lines)
         checked += 1
     assert checked == 2 and puts > 0, "put ops must appear in the export"
+
+
+# ------------------------------------------------------------- 4A ctrler leg
+CTRL_SIM = SimConfig(
+    n_nodes=5, p_client_cmd=0.0, compact_at_commit=False, loss_prob=0.1,
+    p_crash=0.01, p_restart=0.2, max_dead=2, p_repartition=0.02, p_heal=0.05,
+    log_cap=32, compact_every=8,
+)
+
+
+def test_ctrler_bridge_exact_map_on_clean_run():
+    """The 4A leg's strongest form: the config service is a deterministic
+    state machine, so a bug-free committed-op stream must reproduce the TPU
+    walker's EXACT config history on the C++ ShardInfo — same final owner
+    map (gid g <-> Gid g+1), same config count. This proves both backends
+    implement the same canonical rebalance spec, not merely the same
+    balance/minimality properties."""
+    from madraft_tpu.tpusim.ctrler import CtrlerConfig, ctrler_fuzz
+
+    binary = _ensure_binary("madtpu_ctrler_replay")
+    kcfg = CtrlerConfig()
+    n_ticks = 320
+    rep = ctrler_fuzz(CTRL_SIM, kcfg, seed=11, n_clusters=8, n_ticks=n_ticks)
+    assert rep.n_violating == 0
+    checked = 0
+    for cid in range(8):
+        if rep.configs_created[cid] < 5:
+            continue
+        sched = bridge.extract_ctrler_schedule(
+            CTRL_SIM, kcfg, 11, cid, n_ticks
+        )
+        assert sched.bug == "none" and sched.expect_cfgs >= 5
+        cpp = bridge.replay_ctrler_on_simcore(sched, binary=binary)
+        assert cpp["map_match"] == 1, (sched.dumps(), cpp)
+        assert cpp["balance_bad"] == 0 and cpp["minimal_bad"] == 0, cpp
+        assert cpp["configs"] == sched.expect_cfgs
+        checked += 1
+        if checked >= 3:
+            break
+    assert checked >= 2, "not enough config churn exported to prove parity"
+
+
+def test_ctrler_bridge_replays_bug_classes():
+    """Each planted 4A rebalance bug found by the TPU oracles must reproduce
+    its violation class on the C++ side with the SAME bug enabled
+    (ctrler.h ctrl_bug_mode), and the bug-stripped replay must be clean —
+    the same contract as the raft and shardkv legs."""
+    from madraft_tpu.tpusim.ctrler import CtrlerConfig, ctrler_fuzz
+
+    binary = _ensure_binary("madtpu_ctrler_replay")
+    n_ticks = 320
+    for bug_kw in ("bug_rotate_tiebreak", "bug_greedy_rebalance",
+                   "bug_full_reshuffle"):
+        kcfg = CtrlerConfig(**{bug_kw: True})
+        rep = ctrler_fuzz(CTRL_SIM, kcfg, seed=11, n_clusters=32,
+                          n_ticks=n_ticks)
+        bad = rep.violating_clusters()
+        assert bad.size > 0, f"{bug_kw} must fire on the TPU backend"
+        matched = False
+        for cid in bad[:6]:
+            sched = bridge.extract_ctrler_schedule(
+                CTRL_SIM, kcfg, 11, int(cid), n_ticks
+            )
+            assert sched.violations == rep.violations[cid]
+            cpp = bridge.replay_ctrler_on_simcore(sched, binary=binary)
+            if bridge.ctrler_classes_match(sched.violations, cpp):
+                matched = True
+                clean = bridge.CtrlerSchedule(**{
+                    **sched.__dict__, "bug": "none",
+                })
+                cpp_clean = bridge.replay_ctrler_on_simcore(
+                    clean, binary=binary
+                )
+                assert (
+                    cpp_clean["balance_bad"] == 0
+                    and cpp_clean["minimal_bad"] == 0
+                    and cpp_clean["diverged"] == 0
+                ), f"bug-stripped replay flagged: {cpp_clean}"
+                break
+        assert matched, f"no C++ replay reproduced {bug_kw}'s class"
