@@ -219,6 +219,25 @@ def test_ctrler_full_reshuffle_moves_too_much():
     )
 
 
+def test_ctrler_leader_targeted_cuts():
+    """The 4A service under leader-in-minority partitions and asymmetric
+    one-sided cuts (kvraft tester.rs:184-191's scenario on the config
+    service): a deposed-but-unaware leader keeps accepting Join/Leave/Query
+    ops that must be superseded without breaking any 4A oracle — the
+    failover path behind the reference's config-equality-across-leader-kill
+    assertions (shard_ctrler/tests.rs:280-296)."""
+    cfg = BASE.replace(
+        p_repartition=0.0, p_leader_part=0.03, p_asym_cut=0.05, p_heal=0.06,
+    )
+    rep = ctrler_fuzz(cfg, CT, seed=29, n_clusters=96, n_ticks=384)
+    assert rep.n_violating == 0, (
+        f"violations {rep.violations[rep.violating_clusters()[:8]]}"
+    )
+    assert (rep.acked_ops > 0).mean() > 0.9
+    assert rep.configs_created.sum() > 96 * 3
+    assert rep.queries_done.sum() > 96
+
+
 def test_ctrler_deterministic_and_replay():
     """Same seed => bit-identical report; single-cluster replay reproduces —
     the (seed, cluster_id) replay contract (README.md:42-55)."""
