@@ -166,7 +166,9 @@ class CtrlerConfig:
 
 
 class CtrlerKnobs(NamedTuple):
-    """Dynamic 4A-layer knobs (see CtrlerConfig)."""
+    """Dynamic 4A-layer knobs (see CtrlerConfig). Uniform scalars normally;
+    ``make_ctrler_sweep_fn`` broadcasts them per cluster (heterogeneous
+    workload/bug sweeps in one program, engine.make_sweep_fn's design)."""
 
     p_op: jax.Array
     p_query: jax.Array
@@ -175,6 +177,11 @@ class CtrlerKnobs(NamedTuple):
     bug_rotate_tiebreak: jax.Array
     bug_greedy_rebalance: jax.Array
     bug_full_reshuffle: jax.Array
+
+    def broadcast(self, n_clusters: int) -> "CtrlerKnobs":
+        return CtrlerKnobs(
+            *(jnp.broadcast_to(x, (n_clusters,)) for x in self)
+        )
 
 
 def _pack(cfg: CtrlerConfig, client, seq, arg, kind):
@@ -791,13 +798,15 @@ class CtrlerFuzzReport(NamedTuple):
 @functools.lru_cache(maxsize=None)
 def _ctrler_program(
     static_cfg: SimConfig, static_kcfg: CtrlerConfig, n_clusters: int,
-    mesh: Optional[Mesh],
+    mesh: Optional[Mesh], per_cluster_knobs: bool = False,
 ):
     """One compiled program per static shape; probabilities, bug modes, and
-    tick count are runtime args (uniform scalars — the fast knob layout)."""
+    tick count are runtime args (uniform scalars — the fast knob layout;
+    the per-cluster layout serves make_ctrler_sweep_fn only)."""
     constraint = None
     if mesh is not None:
         constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
+    kn_ax = 0 if per_cluster_knobs else None
 
     def run(seed, kn, ckn, n_ticks) -> CtrlerState:
         base = jax.random.PRNGKey(seed)
@@ -806,18 +815,23 @@ def _ctrler_program(
         )
         states = jax.vmap(
             functools.partial(init_ctrler_cluster, static_cfg, static_kcfg),
-            in_axes=(0, None),
+            in_axes=(0, kn_ax),
         )(keys, kn)
         if constraint is not None:
             states = jax.lax.with_sharding_constraint(
                 states, jax.tree.map(lambda _: constraint, states)
             )
             keys = jax.lax.with_sharding_constraint(keys, constraint)
+            if per_cluster_knobs:
+                kn, ckn = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, constraint),
+                    (kn, ckn),
+                )
 
         def body(_, carry):
             return jax.vmap(
                 functools.partial(ctrler_step, static_cfg, static_kcfg),
-                in_axes=(0, 0, None, None),
+                in_axes=(0, 0, kn_ax, kn_ax),
             )(carry, keys, kn, ckn)
 
         return jax.lax.fori_loop(0, n_ticks, body, states)
@@ -837,6 +851,54 @@ def make_ctrler_fuzz_fn(
     prog = _ctrler_program(cfg.static_key(), kcfg.static_key(), n_clusters, mesh)
     kn = cfg.knobs()
     ckn = kcfg.knobs()
+    ticks = jnp.asarray(n_ticks, jnp.int32)
+    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, ckn, ticks)
+
+
+def _validate_ctrler_knobs(ckn) -> None:
+    """Eager rejection of service-knob values that would silently misbehave
+    inside the compiled program (the engine._validate_knobs analogue)."""
+    k = jax.tree.map(np.asarray, ckn)
+    for name in ("p_op", "p_query", "p_move", "p_retry"):
+        v = getattr(k, name)
+        if (v < 0).any() or (v > 1).any():
+            raise ValueError(f"ctrler knob {name} outside [0, 1]: {v}")
+    if (k.p_query + k.p_move > 1.0).any():
+        raise ValueError(
+            "p_query + p_move must stay <= 1 per cluster (one uniform draw "
+            "splits Query/Move/Join-Leave)"
+        )
+    for name in ("bug_rotate_tiebreak", "bug_greedy_rebalance",
+                 "bug_full_reshuffle"):
+        if getattr(k, name).dtype != np.bool_:
+            raise ValueError(
+                f"ctrler bug knob {name} must be boolean (got "
+                f"{getattr(k, name).dtype}); an int 0/1 matrix would fail "
+                "deep inside the compiled loop with a carry-dtype error"
+            )
+
+
+def make_ctrler_sweep_fn(
+    cfg: SimConfig,
+    knobs,   # config.Knobs, uniform or with leading [n_clusters] axes
+    cknobs,  # CtrlerKnobs, uniform or with leading [n_clusters] axes
+    kcfg: CtrlerConfig,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+):
+    """Like make_ctrler_fuzz_fn, but every cluster runs its own raft AND
+    service knobs — fault intensity, op mix, and the planted rebalance bugs
+    become per-cluster data (one program for a whole mutation matrix)."""
+    from madraft_tpu.tpusim.engine import _validate_knobs
+
+    _check_ctrler_cfg(cfg)
+    _validate_knobs(knobs)
+    _validate_ctrler_knobs(cknobs)
+    prog = _ctrler_program(cfg.static_key(), kcfg.static_key(), n_clusters,
+                           mesh, per_cluster_knobs=True)
+    kn = knobs.broadcast(n_clusters)
+    ckn = cknobs.broadcast(n_clusters)
     ticks = jnp.asarray(n_ticks, jnp.int32)
     return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, ckn, ticks)
 

@@ -129,7 +129,10 @@ class KvConfig:
 
 
 class KvKnobs(NamedTuple):
-    """Dynamic KV-layer knobs (see KvConfig)."""
+    """Dynamic KV-layer knobs (see KvConfig). Uniform scalars normally;
+    ``make_kv_sweep_fn`` broadcasts them per cluster so heterogeneous
+    workload mixes AND bug injections sweep across the batch in one
+    program (engine.make_sweep_fn's design on the service layer)."""
 
     p_op: jax.Array
     p_get: jax.Array
@@ -138,6 +141,9 @@ class KvKnobs(NamedTuple):
     bug_skip_dedup: jax.Array
     bug_apply_uncommitted: jax.Array
     bug_stale_read: jax.Array
+
+    def broadcast(self, n_clusters: int) -> "KvKnobs":
+        return KvKnobs(*(jnp.broadcast_to(x, (n_clusters,)) for x in self))
 
 
 class KvState(NamedTuple):
@@ -615,15 +621,17 @@ class KvFuzzReport(NamedTuple):
 @functools.lru_cache(maxsize=None)
 def _kv_program(
     static_cfg: SimConfig, static_kcfg: KvConfig, n_clusters: int,
-    mesh: Optional[Mesh],
+    mesh: Optional[Mesh], per_cluster_knobs: bool = False,
 ):
     """One compiled program per static shape; probabilities, bug modes, and
     the tick count are runtime arguments. Knobs are UNIFORM runtime scalars
     (vmap in_axes=None) — the fast knob layout; per-cluster knob arrays
-    measured a 2.4x cliff (see engine._fuzz_program)."""
+    measured a 2.4x cliff (see engine._fuzz_program) and are used only by
+    ``make_kv_sweep_fn``, which alone pays for its heterogeneity."""
     constraint = None
     if mesh is not None:
         constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
+    kn_ax = 0 if per_cluster_knobs else None
 
     def run(seed, kn, kkn, n_ticks) -> KvState:
         base = jax.random.PRNGKey(seed)
@@ -632,18 +640,23 @@ def _kv_program(
         )
         states = jax.vmap(
             functools.partial(init_kv_cluster, static_cfg, static_kcfg),
-            in_axes=(0, None),
+            in_axes=(0, kn_ax),
         )(keys, kn)
         if constraint is not None:
             states = jax.lax.with_sharding_constraint(
                 states, jax.tree.map(lambda _: constraint, states)
             )
             keys = jax.lax.with_sharding_constraint(keys, constraint)
+            if per_cluster_knobs:
+                kn, kkn = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, constraint),
+                    (kn, kkn),
+                )
 
         def body(_, carry):
             return jax.vmap(
                 functools.partial(kv_step, static_cfg, static_kcfg),
-                in_axes=(0, 0, None, None),
+                in_axes=(0, 0, kn_ax, kn_ax),
             )(carry, keys, kn, kkn)
 
         return jax.lax.fori_loop(0, n_ticks, body, states)
@@ -665,6 +678,54 @@ def make_kv_fuzz_fn(
     kkn = kcfg.knobs()
     ticks = jnp.asarray(n_ticks, jnp.int32)
     # uint32 coercion: keep the (seed, cluster_id) replay contract under x64
+    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, kkn, ticks)
+
+
+def _validate_kv_knobs(kkn) -> None:
+    """Eager rejection of service-knob values that would silently misbehave
+    inside the compiled program (the engine._validate_knobs analogue)."""
+    k = jax.tree.map(np.asarray, kkn)
+    for name in ("p_op", "p_get", "p_put", "p_retry"):
+        v = getattr(k, name)
+        if (v < 0).any() or (v > 1).any():
+            raise ValueError(f"kv knob {name} outside [0, 1]: {v}")
+    if (k.p_get + k.p_put > 1.0).any():
+        raise ValueError(
+            "p_get + p_put must stay <= 1 per cluster (one uniform draw "
+            "splits Get/Put/Append)"
+        )
+    for name in ("bug_skip_dedup", "bug_apply_uncommitted", "bug_stale_read"):
+        if getattr(k, name).dtype != np.bool_:
+            raise ValueError(
+                f"kv bug knob {name} must be boolean (got "
+                f"{getattr(k, name).dtype}); an int 0/1 matrix would fail "
+                "deep inside the compiled loop with a carry-dtype error"
+            )
+
+
+def make_kv_sweep_fn(
+    cfg: SimConfig,
+    knobs,   # config.Knobs, uniform or with leading [n_clusters] axes
+    kknobs,  # KvKnobs, uniform or with leading [n_clusters] axes
+    kcfg: KvConfig,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+):
+    """Like make_kv_fuzz_fn, but every cluster runs its own raft AND
+    service knobs — fault intensity, workload mix, and even the BUG
+    injections become per-cluster data, so a whole mutation-testing matrix
+    (which clusters run which planted bug) executes in ONE program."""
+    from madraft_tpu.tpusim.engine import _validate_knobs
+
+    _check_kv_cfg(cfg)
+    _validate_knobs(knobs)
+    _validate_kv_knobs(kknobs)
+    prog = _kv_program(cfg.static_key(), kcfg.static_key(), n_clusters, mesh,
+                       per_cluster_knobs=True)
+    kn = knobs.broadcast(n_clusters)
+    kkn = kknobs.broadcast(n_clusters)
+    ticks = jnp.asarray(n_ticks, jnp.int32)
     return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, kkn, ticks)
 
 

@@ -270,3 +270,32 @@ def test_ctrler_sharded_over_mesh():
         rep_sharded.configs_created, rep_local.configs_created
     )
     assert rep_sharded.n_violating == 0
+
+
+def test_ctrler_sweep_per_cluster_knobs_and_bugs():
+    """4A sweeps (make_ctrler_sweep_fn): uniform-valued sweep reproduces
+    the uniform program exactly, and a per-cluster bug axis (greedy
+    rebalance in the first half) lands every violation in that half."""
+    from madraft_tpu.tpusim.ctrler import (
+        ctrler_report,
+        make_ctrler_sweep_fn,
+    )
+
+    n, ticks = 48, 320
+    fn = make_ctrler_sweep_fn(BASE, BASE.knobs(), CT.knobs(), CT, n, ticks)
+    rep_sweep = ctrler_report(
+        jax.block_until_ready(fn(jnp.asarray(11, jnp.uint32)))
+    )
+    rep_uni = ctrler_fuzz(BASE, CT, seed=11, n_clusters=n, n_ticks=ticks)
+    for a, b in zip(rep_sweep, rep_uni):
+        np.testing.assert_array_equal(a, b)
+
+    half = jnp.arange(n) < n // 2
+    ckn = CT.knobs()._replace(bug_greedy_rebalance=half)
+    fn = make_ctrler_sweep_fn(BASE, BASE.knobs(), ckn, CT, n, ticks)
+    rep = ctrler_report(jax.block_until_ready(fn(jnp.asarray(11, jnp.uint32))))
+    bugged = np.asarray(half)
+    viol = rep.violations != 0
+    assert viol[bugged].any(), "bugged half produced no balance violation"
+    assert (rep.violations[bugged & viol] & VIOLATION_CTRL_BALANCE).all()
+    assert not viol[~bugged].any()

@@ -154,3 +154,41 @@ def test_kv_stale_read_oracle_fires_with_puts():
     assert rep.n_violating > 0, "stale-read bug with puts escaped the oracle"
     bits = rep.violations[rep.violating_clusters()]
     assert (bits & VIOLATION_STALE_READ).any()
+
+
+def test_kv_sweep_per_cluster_knobs_and_bugs():
+    """Service-layer sweeps (make_kv_sweep_fn): per-cluster raft AND kv
+    knobs in ONE program. Two properties: (a) a sweep whose knobs are all
+    equal reproduces the uniform-layout program bit-for-bit (same cluster
+    keys, same draws — only the knob layout differs); (b) the BUG axis is
+    per-cluster data — planting bug_stale_read in exactly half the batch
+    puts every violation in that half."""
+    import jax.numpy as jnp
+
+    from madraft_tpu.tpusim.kv import kv_report, make_kv_sweep_fn
+
+    n, ticks = 48, 320
+    # (a) uniform-valued sweep == uniform program
+    fn = make_kv_sweep_fn(BASE, BASE.knobs(), KV.knobs(), KV, n, ticks)
+    rep_sweep = kv_report(jax.block_until_ready(fn(jnp.asarray(7, jnp.uint32))))
+    rep_uni = kv_fuzz(BASE, KV, seed=7, n_clusters=n, n_ticks=ticks)
+    for a, b in zip(rep_sweep, rep_uni):
+        np.testing.assert_array_equal(a, b)
+
+    # (b) the bug axis as data: stale-read serving in the first half only
+    half = jnp.arange(n) < n // 2
+    kkn = KV.replace(p_get=0.5).knobs()._replace(bug_stale_read=half)
+    fn = make_kv_sweep_fn(BASE, BASE.knobs(), kkn, KV, n, ticks)
+    rep = kv_report(jax.block_until_ready(fn(jnp.asarray(7, jnp.uint32))))
+    bugged = np.asarray(half)
+    viol = rep.violations != 0
+    assert viol[bugged].any(), "bugged half produced no stale read"
+    assert (rep.violations[bugged & viol] & VIOLATION_STALE_READ).all()
+    assert not viol[~bugged].any(), (
+        f"clean half flagged: {rep.violations[~bugged & viol]}"
+    )
+
+    # knob validation is eager
+    bad = KV.knobs()._replace(p_get=jnp.float32(0.8), p_put=jnp.float32(0.5))
+    with pytest.raises(ValueError, match="p_get"):
+        make_kv_sweep_fn(BASE, BASE.knobs(), bad, KV, n, ticks)

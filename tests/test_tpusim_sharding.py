@@ -51,3 +51,45 @@ def test_sharded_state_placement():
     final = fn(jnp.asarray(2, jnp.uint32))
     # cluster axis actually sharded over all devices
     assert len(final.term.sharding.device_set) == len(jax.devices())
+
+
+def test_sharded_service_sweeps_match_unsharded():
+    # The kv/ctrler sweep programs have their own per-cluster-knob mesh
+    # branch (service knobs sharding-constrained along the cluster axis,
+    # kv.py _kv_program / ctrler.py _ctrler_program); a heterogeneous
+    # workload-and-bug sweep must be identical sharded and unsharded.
+    from madraft_tpu.tpusim.ctrler import (
+        CtrlerConfig,
+        ctrler_report,
+        make_ctrler_sweep_fn,
+    )
+    from madraft_tpu.tpusim.kv import KvConfig, kv_report, make_kv_sweep_fn
+
+    cfg = SimConfig(
+        n_nodes=3, p_client_cmd=0.0, compact_at_commit=False,
+        loss_prob=0.05, log_cap=32, compact_every=8,
+    )
+    half = jnp.arange(16) < 8
+    kv = KvConfig()
+    kkn = kv.knobs()._replace(
+        p_get=jnp.where(half, 0.0, 0.5).astype(jnp.float32),
+        bug_stale_read=~half,
+    )
+    a = kv_report(make_kv_sweep_fn(cfg, cfg.knobs(), kkn, kv, 16, 200)(9))
+    b = kv_report(
+        make_kv_sweep_fn(cfg, cfg.knobs(), kkn, kv, 16, 200, mesh=_mesh())(9)
+    )
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+    ct = CtrlerConfig()
+    ckn = ct.knobs()._replace(bug_greedy_rebalance=~half)
+    a = ctrler_report(
+        make_ctrler_sweep_fn(cfg, cfg.knobs(), ckn, ct, 16, 200)(9)
+    )
+    b = ctrler_report(
+        make_ctrler_sweep_fn(cfg, cfg.knobs(), ckn, ct, 16, 200,
+                             mesh=_mesh())(9)
+    )
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
