@@ -5,6 +5,7 @@
     python -m madraft_tpu kv-fuzz     --clusters 512  --ticks 512
     python -m madraft_tpu ctrler-fuzz --clusters 512  --ticks 512
     python -m madraft_tpu shardkv-fuzz --clusters 64  --ticks 640
+    python -m madraft_tpu sweep       --loss 0,0.1,0.3 --crash 0,0.02
     python -m madraft_tpu replay      --seed S --cluster C --ticks T [--storm]
     python -m madraft_tpu bridge      --seed S --cluster C --ticks T [--storm]
 
@@ -74,25 +75,27 @@ def _reports_equal(a, b) -> bool:
     )
 
 
-def _finish_fuzz(args, run):
-    """Run a fuzz closure, optionally double-run for the determinism check,
-    print the JSON report, and return the exit code.
-
-    The check is the reference's MADSIM_TEST_CHECK_DETERMINISTIC contract on
-    the batched backend (/root/reference/README.md:81-87): re-run the
-    identical program and demand a bit-identical report. Enabled by
-    --check-deterministic or the env var MADTPU_TEST_CHECK_DETERMINISTIC —
-    which shares the C++ runner's semantics: unset, empty, or "0" disables."""
+def _det_check(args, rep, rerun):
+    """The MADSIM_TEST_CHECK_DETERMINISTIC contract on the batched backend
+    (/root/reference/README.md:81-87): re-run the identical program and
+    demand a bit-identical report. Enabled by --check-deterministic or the
+    env var MADTPU_TEST_CHECK_DETERMINISTIC — which shares the C++ runner's
+    semantics: unset, empty, or "0" disables. Returns (extra_json_fields,
+    failed)."""
     import os
 
-    rep = run()
     env = os.environ.get("MADTPU_TEST_CHECK_DETERMINISTIC", "0")
-    extra = {}
-    det_failed = False
-    if args.check_deterministic or env not in ("", "0"):
-        same = _reports_equal(rep, run())
-        extra = {"deterministic": bool(same)}
-        det_failed = not same
+    if not (args.check_deterministic or env not in ("", "0")):
+        return {}, False
+    same = _reports_equal(rep, rerun())
+    return {"deterministic": bool(same)}, not same
+
+
+def _finish_fuzz(args, run):
+    """Run a fuzz closure, optionally double-run for the determinism check,
+    print the JSON report, and return the exit code."""
+    rep = run()
+    extra, det_failed = _det_check(args, rep, run)
     _report_json(rep, {"seed": args.seed, **extra})
     return 1 if (rep.n_violating or det_failed) else 0
 
@@ -181,6 +184,90 @@ def cmd_shardkv_fuzz(args):
             n_ticks=args.ticks, mesh=mesh)
 
     return _finish_fuzz(args, run)
+
+
+def cmd_sweep(args):
+    """Fault-parameter grid in ONE compiled program (engine.make_sweep_fn):
+    the cartesian product of --loss x --crash x --repartition tiles across
+    the cluster batch; per-cell safety AND liveness are reported. The
+    reference's analogue is a compile-time test matrix, one process per
+    cell."""
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    from madraft_tpu.tpusim.engine import make_sweep_fn, report
+
+    cfg = _sim_config(args)
+    axes = {
+        "loss_prob": [float(x) for x in args.loss.split(",")],
+        "p_crash": [float(x) for x in args.crash.split(",")],
+        "p_repartition": [float(x) for x in args.repartition.split(",")],
+    }
+    combos = list(itertools.product(*axes.values()))
+    per = args.clusters // len(combos)
+    if per == 0:
+        raise SystemExit(
+            f"--clusters {args.clusters} < {len(combos)} grid cells"
+        )
+    n = per * len(combos)
+    mesh = None
+    if args.mesh:
+        import numpy as np
+
+        devs = np.array(jax.devices())
+        # validate on the TRUNCATED batch n (args.clusters rounds down to a
+        # multiple of the cell count), not on the requested cluster count
+        if n % len(devs):
+            raise SystemExit(
+                f"sweep batch {n} ({len(combos)} cells x {per}) must divide "
+                f"evenly over {len(devs)} devices — pick --clusters as a "
+                f"multiple of {len(combos) * len(devs)}"
+            )
+        mesh = jax.sharding.Mesh(devs, ("clusters",))
+    if any(c[1] > 0 for c in combos) and cfg.max_dead == 0:
+        # crash cells are inert without a dead-node budget + restarts
+        cfg = cfg.replace(max_dead=2, p_restart=max(cfg.p_restart, 0.2))
+    if any(c[2] > 0 for c in combos) and cfg.p_heal == 0.0:
+        cfg = cfg.replace(p_heal=0.05)
+
+    def tile(i):
+        return jnp.repeat(
+            jnp.asarray([c[i] for c in combos], jnp.float32), per,
+            total_repeat_length=n,
+        )
+
+    kn = cfg.knobs()._replace(
+        **{name: tile(i) for i, name in enumerate(axes)}
+    )
+    fn = make_sweep_fn(cfg, kn, n, args.ticks, mesh=mesh)
+
+    def run():
+        return report(jax.block_until_ready(fn(args.seed)))
+
+    rep = run()
+    extra, det_failed = _det_check(args, rep, run)
+    cells = []
+    for i, c in enumerate(combos):
+        sl = slice(i * per, (i + 1) * per)
+        cells.append({
+            "loss": c[0], "crash": c[1], "repartition": c[2],
+            "clusters": per,
+            "violating": int((rep.violations[sl] != 0).sum()),
+            "live": int((rep.committed[sl] > 0).sum()),
+            "committed_mean": round(float(rep.committed[sl].mean()), 1),
+        })
+    print(json.dumps({
+        "violating": int(rep.n_violating),
+        # n rounds --clusters DOWN to a multiple of the cell count — surface
+        # it so coverage accounting never silently over-reads
+        "clusters_run": n,
+        "cells": cells,
+        "seed": args.seed,
+        **extra,
+    }))
+    return 1 if (rep.n_violating or det_failed) else 0
 
 
 def cmd_replay(args):
@@ -272,6 +359,18 @@ def main(argv=None) -> int:
     sp.add_argument("--p-get", type=float, default=0.3)
     sp.add_argument("--p-put", type=float, default=0.2)
     sp.set_defaults(fn=cmd_shardkv_fuzz)
+
+    sp = sub.add_parser(
+        "sweep", help="fault-parameter grid in one program (per-cell report)"
+    )
+    fuzz_common(sp, 4096)
+    sp.add_argument("--loss", default="0,0.1,0.3",
+                    help="comma list of loss_prob values")
+    sp.add_argument("--crash", default="0,0.02",
+                    help="comma list of p_crash values")
+    sp.add_argument("--repartition", default="0,0.05",
+                    help="comma list of p_repartition values")
+    sp.set_defaults(fn=cmd_sweep)
 
     sp = sub.add_parser("replay", help="re-run ONE cluster exactly")
     common(sp, 1)

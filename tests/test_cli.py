@@ -79,3 +79,19 @@ def test_cli_service_layers():
 
     rc, out = run(["shardkv-fuzz", "--clusters", "8", "--ticks", "440"])
     assert rc == 0 and out["violating"] == 0 and out["installs_mean"] > 0
+
+
+def test_cli_sweep_grid():
+    # the fault-grid verb: 12 cells x 4 clusters in one program, per-cell
+    # safety + liveness; exit 1 iff any cell had a violation
+    rc, out = run(["sweep", "--clusters", "48", "--ticks", "256",
+                   "--check-deterministic"])
+    assert rc == 0 and out["violating"] == 0, out
+    assert out["deterministic"] is True
+    assert len(out["cells"]) == 12
+    lossless = [c for c in out["cells"] if c["loss"] == 0.0]
+    assert all(c["live"] == c["clusters"] for c in lossless), (
+        "lossless cells must all commit"
+    )
+    with pytest.raises(SystemExit):
+        run(["sweep", "--clusters", "4", "--ticks", "16"])  # < cells
