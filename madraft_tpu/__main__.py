@@ -126,18 +126,47 @@ def cmd_fuzz(args):
     return _finish_fuzz(args, run)
 
 
+def _service_bugs(cfg_cls) -> set:
+    """The layer's planted-bug names, derived from its config dataclass's
+    bug_* fields — one source of truth, so a new bug knob is automatically
+    reachable from the CLI."""
+    import dataclasses
+
+    return {
+        f.name[len("bug_"):]
+        for f in dataclasses.fields(cfg_cls)
+        if f.name.startswith("bug_")
+    }
+
+
+def _with_service_bug(kcfg, name):
+    """Set the layer's planted-bug knob named by --service-bug ('' = none).
+    Unknown names are rejected eagerly — a typo'd bug silently fuzzing the
+    correct service would read as 'bug not caught'."""
+    if not name:
+        return kcfg
+    known = _service_bugs(type(kcfg))
+    if name not in known:
+        raise SystemExit(
+            f"unknown service bug {name!r}; this layer knows: {sorted(known)}"
+        )
+    return kcfg.replace(**{f"bug_{name}": True})
+
+
 def cmd_kv_fuzz(args):
     from madraft_tpu.tpusim.kv import KvConfig, kv_fuzz
 
     cfg = _sim_config(args).replace(
         p_client_cmd=0.0, compact_at_commit=False, compact_every=16
     )
+    kcfg = _with_service_bug(
+        KvConfig(p_get=args.p_get, p_put=args.p_put), args.service_bug
+    )
 
     mesh = _mesh(args)
 
     def run():
-        return kv_fuzz(cfg, KvConfig(p_get=args.p_get, p_put=args.p_put),
-                       seed=args.seed,
+        return kv_fuzz(cfg, kcfg, seed=args.seed,
                        n_clusters=args.clusters, n_ticks=args.ticks, mesh=mesh)
 
     return _finish_fuzz(args, run)
@@ -149,13 +178,16 @@ def cmd_ctrler_fuzz(args):
     cfg = _sim_config(args).replace(
         p_client_cmd=0.0, compact_at_commit=False, log_cap=32, compact_every=8
     )
+    kcfg = _with_service_bug(
+        CtrlerConfig(p_query=args.p_query, p_move=args.p_move),
+        args.service_bug,
+    )
 
     mesh = _mesh(args)
 
     def run():
         return ctrler_fuzz(
-            cfg,
-            CtrlerConfig(p_query=args.p_query, p_move=args.p_move),
+            cfg, kcfg,
             seed=args.seed, n_clusters=args.clusters, n_ticks=args.ticks,
             mesh=mesh)
 
@@ -175,11 +207,15 @@ def cmd_shardkv_fuzz(args):
         bug=args.bug,
     )
 
+    kcfg = _with_service_bug(
+        ShardKvConfig(p_get=args.p_get, p_put=args.p_put), args.service_bug
+    )
+
     mesh = _mesh(args)
 
     def run():
         return shardkv_fuzz(
-            cfg, ShardKvConfig(p_get=args.p_get, p_put=args.p_put),
+            cfg, kcfg,
             seed=args.seed, n_clusters=args.clusters,
             n_ticks=args.ticks, mesh=mesh)
 
@@ -336,12 +372,24 @@ def main(argv=None) -> int:
                              "also enabled by the env var "
                              "MADTPU_TEST_CHECK_DETERMINISTIC)")
 
+    def service_common(sp, clusters):
+        fuzz_common(sp, clusters)
+        # help stays static so --help never pays the jax import the cmd_*
+        # handlers defer; the valid names are derived from the layer's
+        # config dataclass at use time (_service_bugs) and an unknown name
+        # errors with the full list
+        sp.add_argument(
+            "--service-bug", default="",
+            help="plant one of this layer's SERVICE bugs (README "
+                 "planted-bug library; an unknown name lists the valid set)",
+        )
+
     sp = sub.add_parser("fuzz", help="raw-raft batched fuzz")
     fuzz_common(sp, 4096)
     sp.set_defaults(fn=cmd_fuzz)
 
     sp = sub.add_parser("kv-fuzz", help="KV service fuzz (Lab 3)")
-    fuzz_common(sp, 512)
+    service_common(sp, 512)
     sp.add_argument("--p-get", type=float, default=0.3)
     sp.add_argument("--p-put", type=float, default=0.2)
     sp.set_defaults(fn=cmd_kv_fuzz)
@@ -349,13 +397,13 @@ def main(argv=None) -> int:
     sp = sub.add_parser(
         "ctrler-fuzz", help="shard-controller config service (Lab 4A)"
     )
-    fuzz_common(sp, 512)
+    service_common(sp, 512)
     sp.add_argument("--p-query", type=float, default=0.3)
     sp.add_argument("--p-move", type=float, default=0.1)
     sp.set_defaults(fn=cmd_ctrler_fuzz)
 
     sp = sub.add_parser("shardkv-fuzz", help="multi-group sharded KV (Lab 4B)")
-    fuzz_common(sp, 64)
+    service_common(sp, 64)
     sp.add_argument("--p-get", type=float, default=0.3)
     sp.add_argument("--p-put", type=float, default=0.2)
     sp.set_defaults(fn=cmd_shardkv_fuzz)

@@ -95,3 +95,22 @@ def test_cli_sweep_grid():
     )
     with pytest.raises(SystemExit):
         run(["sweep", "--clusters", "4", "--ticks", "16"])  # < cells
+
+
+def test_cli_service_bug_flag():
+    # the planted-bug library from the front door: each layer's bug fires
+    # (exit 1 + violations) and unknown names / wrong verbs are rejected
+    rc, out = run(["kv-fuzz", "--clusters", "32", "--ticks", "256", "--storm",
+                   "--service-bug", "stale_read"])
+    assert rc == 1 and out["violating"] > 0, out
+
+    rc, out = run(["ctrler-fuzz", "--clusters", "32", "--ticks", "256",
+                   "--storm", "--service-bug", "greedy_rebalance"])
+    assert rc == 1 and out["violating"] > 0, out
+
+    with pytest.raises(SystemExit):
+        run(["kv-fuzz", "--clusters", "8", "--ticks", "16",
+             "--service-bug", "not_a_bug"])
+    with pytest.raises(SystemExit):
+        run(["fuzz", "--clusters", "8", "--ticks", "16",
+             "--service-bug", "stale_read"])
