@@ -858,24 +858,19 @@ def make_ctrler_fuzz_fn(
 def _validate_ctrler_knobs(ckn) -> None:
     """Eager rejection of service-knob values that would silently misbehave
     inside the compiled program (the engine._validate_knobs analogue)."""
+    from madraft_tpu.tpusim.engine import validate_bool_bugs, validate_probs
+
     k = jax.tree.map(np.asarray, ckn)
-    for name in ("p_op", "p_query", "p_move", "p_retry"):
-        v = getattr(k, name)
-        if (v < 0).any() or (v > 1).any():
-            raise ValueError(f"ctrler knob {name} outside [0, 1]: {v}")
+    validate_probs(k, ("p_op", "p_query", "p_move", "p_retry"), "ctrler")
     if (k.p_query + k.p_move > 1.0).any():
         raise ValueError(
             "p_query + p_move must stay <= 1 per cluster (one uniform draw "
             "splits Query/Move/Join-Leave)"
         )
-    for name in ("bug_rotate_tiebreak", "bug_greedy_rebalance",
-                 "bug_full_reshuffle"):
-        if getattr(k, name).dtype != np.bool_:
-            raise ValueError(
-                f"ctrler bug knob {name} must be boolean (got "
-                f"{getattr(k, name).dtype}); an int 0/1 matrix would fail "
-                "deep inside the compiled loop with a carry-dtype error"
-            )
+    validate_bool_bugs(
+        k, ("bug_rotate_tiebreak", "bug_greedy_rebalance",
+            "bug_full_reshuffle"), "ctrler",
+    )
 
 
 def make_ctrler_sweep_fn(
@@ -890,10 +885,14 @@ def make_ctrler_sweep_fn(
     """Like make_ctrler_fuzz_fn, but every cluster runs its own raft AND
     service knobs — fault intensity, op mix, and the planted rebalance bugs
     become per-cluster data (one program for a whole mutation matrix)."""
-    from madraft_tpu.tpusim.engine import _validate_knobs
+    from madraft_tpu.tpusim.engine import (
+        _validate_knobs,
+        validate_service_raft_knobs,
+    )
 
     _check_ctrler_cfg(cfg)
     _validate_knobs(knobs)
+    validate_service_raft_knobs(knobs)
     _validate_ctrler_knobs(cknobs)
     prog = _ctrler_program(cfg.static_key(), kcfg.static_key(), n_clusters,
                            mesh, per_cluster_knobs=True)

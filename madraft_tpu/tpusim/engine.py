@@ -147,6 +147,45 @@ def _validate_knobs(knobs) -> None:
         raise ValueError("flow_cap and compact_every must be >= 1")
 
 
+def validate_probs(k, names, layer: str) -> None:
+    """Shared [0,1] range check for probability knobs (k = numpy-mapped
+    knob pytree) — one copy of the rule for every layer's validator."""
+    for name in names:
+        v = getattr(k, name)
+        if (v < 0).any() or (v > 1).any():
+            raise ValueError(f"{layer} knob {name} outside [0, 1]: {v}")
+
+
+def validate_bool_bugs(k, names, layer: str) -> None:
+    """Shared bool-dtype check for planted-bug knob axes: an int 0/1 matrix
+    would otherwise fail deep inside the compiled loop with an opaque
+    carry-dtype error."""
+    for name in names:
+        if getattr(k, name).dtype != np.bool_:
+            raise ValueError(
+                f"{layer} bug knob {name} must be boolean (got "
+                f"{getattr(k, name).dtype}); an int 0/1 matrix would fail "
+                "deep inside the compiled loop with a carry-dtype error"
+            )
+
+
+def validate_service_raft_knobs(knobs) -> None:
+    """Service-layer sweeps: the RAFT knob values that reach the program
+    (the static cfg's dynamic fields are pinned and never read) must leave
+    command injection and the compaction boundary to the service layer."""
+    k = jax.tree.map(np.asarray, knobs)
+    if (k.p_client_cmd != 0).any():
+        raise ValueError(
+            "service-layer sweeps need p_client_cmd == 0 in the raft knobs "
+            "(the service layer owns command injection)"
+        )
+    if k.compact_at_commit.any():
+        raise ValueError(
+            "service-layer sweeps need compact_at_commit=False in the raft "
+            "knobs (the compaction boundary must follow the apply cursor)"
+        )
+
+
 def make_sweep_fn(
     cfg: SimConfig,
     knobs,  # config.Knobs with leading [n_clusters] axes (heterogeneous)

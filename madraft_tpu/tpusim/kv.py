@@ -684,23 +684,18 @@ def make_kv_fuzz_fn(
 def _validate_kv_knobs(kkn) -> None:
     """Eager rejection of service-knob values that would silently misbehave
     inside the compiled program (the engine._validate_knobs analogue)."""
+    from madraft_tpu.tpusim.engine import validate_bool_bugs, validate_probs
+
     k = jax.tree.map(np.asarray, kkn)
-    for name in ("p_op", "p_get", "p_put", "p_retry"):
-        v = getattr(k, name)
-        if (v < 0).any() or (v > 1).any():
-            raise ValueError(f"kv knob {name} outside [0, 1]: {v}")
+    validate_probs(k, ("p_op", "p_get", "p_put", "p_retry"), "kv")
     if (k.p_get + k.p_put > 1.0).any():
         raise ValueError(
             "p_get + p_put must stay <= 1 per cluster (one uniform draw "
             "splits Get/Put/Append)"
         )
-    for name in ("bug_skip_dedup", "bug_apply_uncommitted", "bug_stale_read"):
-        if getattr(k, name).dtype != np.bool_:
-            raise ValueError(
-                f"kv bug knob {name} must be boolean (got "
-                f"{getattr(k, name).dtype}); an int 0/1 matrix would fail "
-                "deep inside the compiled loop with a carry-dtype error"
-            )
+    validate_bool_bugs(
+        k, ("bug_skip_dedup", "bug_apply_uncommitted", "bug_stale_read"), "kv"
+    )
 
 
 def make_kv_sweep_fn(
@@ -716,10 +711,14 @@ def make_kv_sweep_fn(
     service knobs — fault intensity, workload mix, and even the BUG
     injections become per-cluster data, so a whole mutation-testing matrix
     (which clusters run which planted bug) executes in ONE program."""
-    from madraft_tpu.tpusim.engine import _validate_knobs
+    from madraft_tpu.tpusim.engine import (
+        _validate_knobs,
+        validate_service_raft_knobs,
+    )
 
     _check_kv_cfg(cfg)
     _validate_knobs(knobs)
+    validate_service_raft_knobs(knobs)
     _validate_kv_knobs(kknobs)
     prog = _kv_program(cfg.static_key(), kcfg.static_key(), n_clusters, mesh,
                        per_cluster_knobs=True)

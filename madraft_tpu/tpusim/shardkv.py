@@ -162,6 +162,60 @@ class ShardKvConfig:
     def replace(self, **kw) -> "ShardKvConfig":
         return dataclasses.replace(self, **kw)
 
+    def knobs(self) -> "ShardKvKnobs":
+        return ShardKvKnobs(
+            cfg_interval=jnp.int32(self.cfg_interval),
+            p_op=jnp.float32(self.p_op),
+            p_get=jnp.float32(self.p_get),
+            p_put=jnp.float32(self.p_put),
+            p_retry=jnp.float32(self.p_retry),
+            p_cfg_learn=jnp.float32(self.p_cfg_learn),
+            p_pull=jnp.float32(self.p_pull),
+            p_ack=jnp.float32(self.p_ack),
+            pull_delay_min=jnp.int32(self.pull_delay_min),
+            pull_delay_max=jnp.int32(self.pull_delay_max),
+            pull_loss=jnp.float32(self.pull_loss),
+            bug_skip_freeze=jnp.bool_(self.bug_skip_freeze),
+            bug_drop_dup_table=jnp.bool_(self.bug_drop_dup_table),
+            bug_serve_frozen=jnp.bool_(self.bug_serve_frozen),
+        )
+
+    def static_key(self) -> "ShardKvConfig":
+        """Only the shape-determining fields; everything else rides in
+        ShardKvKnobs, so configs differing in probabilities, intervals, or
+        bug modes share ONE compiled program (the config.py design, landed
+        on this layer last — it previously recompiled per config)."""
+        return ShardKvConfig(
+            n_groups=self.n_groups, n_shards=self.n_shards,
+            n_clients=self.n_clients, n_configs=self.n_configs,
+            apply_max=self.apply_max, walk_max=self.walk_max,
+        )
+
+
+class ShardKvKnobs(NamedTuple):
+    """Dynamic shardkv-layer knobs (see ShardKvConfig). Uniform scalars
+    normally; ``make_shardkv_sweep_fn`` broadcasts them per deployment."""
+
+    cfg_interval: jax.Array
+    p_op: jax.Array
+    p_get: jax.Array
+    p_put: jax.Array
+    p_retry: jax.Array
+    p_cfg_learn: jax.Array
+    p_pull: jax.Array
+    p_ack: jax.Array
+    pull_delay_min: jax.Array
+    pull_delay_max: jax.Array
+    pull_loss: jax.Array
+    bug_skip_freeze: jax.Array
+    bug_drop_dup_table: jax.Array
+    bug_serve_frozen: jax.Array
+
+    def broadcast(self, n_clusters: int) -> "ShardKvKnobs":
+        return ShardKvKnobs(
+            *(jnp.broadcast_to(x, (n_clusters,)) for x in self)
+        )
+
 
 def _pack_op(cfg: ShardKvConfig, client, seq, shard, kind):
     """APPEND or GET client op."""
@@ -275,7 +329,7 @@ class ShardKvState(NamedTuple):
     first_violation_tick: jax.Array
 
 
-def _gen_schedule(cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array):
+def _gen_schedule(cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array, skn):
     """Config schedule: activation ticks + owner maps, as Join/Leave churn.
 
     Config 0 assigns shards round-robin over all groups. Each later config is
@@ -295,7 +349,7 @@ def _gen_schedule(cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array):
     ncfg, ns, g = kcfg.n_configs, kcfg.n_shards, kcfg.n_groups
     kt, km = jax.random.split(jax.random.fold_in(key, _S_CFGGEN))
     gaps = jax.random.randint(
-        kt, (ncfg,), kcfg.cfg_interval // 2, kcfg.cfg_interval * 3 // 2 + 1,
+        kt, (ncfg,), skn.cfg_interval // 2, skn.cfg_interval * 3 // 2 + 1,
         dtype=I32,
     )
     cfg_tick = jnp.cumsum(gaps) - gaps[0]  # config 0 active from tick 0
@@ -358,15 +412,28 @@ def _gen_schedule(cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array):
     return cfg_tick, cfg_owner
 
 
+def _check_shardkv_cfg(cfg: SimConfig) -> None:
+    assert cfg.p_client_cmd == 0.0, "shardkv layer owns command injection"
+    assert not cfg.compact_at_commit, (
+        "shardkv needs compact_at_commit=False (boundary = apply cursor)"
+    )
+
+
 def init_shardkv_cluster(
-    cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array
+    cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array, kn=None, skn=None
 ) -> ShardKvState:
+    if kn is None:
+        kn = cfg.knobs()
+    if skn is None:
+        skn = kcfg.knobs()
     g, n, ns, nc = kcfg.n_groups, cfg.n_nodes, kcfg.n_shards, kcfg.n_clients
     gkeys = jax.vmap(lambda i: jax.random.fold_in(key, _S_GROUP + i))(
         jnp.arange(g)
     )
-    rafts = jax.vmap(functools.partial(init_cluster, cfg))(gkeys)
-    cfg_tick, cfg_owner = _gen_schedule(cfg, kcfg, key)
+    rafts = jax.vmap(
+        functools.partial(init_cluster, cfg), in_axes=(0, None)
+    )(gkeys, kn)
+    cfg_tick, cfg_owner = _gen_schedule(cfg, kcfg, key, skn)
     phase0 = jnp.where(
         cfg_owner[0][None, None, :] == jnp.arange(g, dtype=I32)[:, None, None],
         OWNED, ABSENT,
@@ -426,20 +493,27 @@ def init_shardkv_cluster(
 
 
 def shardkv_step(
-    cfg: SimConfig, kcfg: ShardKvConfig, st: ShardKvState, cluster_key: jax.Array
+    cfg: SimConfig, kcfg: ShardKvConfig, st: ShardKvState,
+    cluster_key: jax.Array, kn=None, skn=None,
 ) -> ShardKvState:
     """One lockstep tick of a whole deployment."""
-    assert cfg.p_client_cmd == 0.0, "shardkv layer owns command injection"
-    assert not cfg.compact_at_commit, (
-        "shardkv needs compact_at_commit=False (boundary = apply cursor)"
-    )
+    if kn is None:
+        # direct (non-program) callers derive knobs from cfg, so cfg must be
+        # the REAL config here — check it; program callers pass kn/skn and a
+        # static_key() cfg whose pinned dynamic fields are never read
+        _check_shardkv_cfg(cfg)
+        kn = cfg.knobs()
+    if skn is None:
+        skn = kcfg.knobs()
     g, n, cap = kcfg.n_groups, cfg.n_nodes, cfg.log_cap
     ns, nc = kcfg.n_shards, kcfg.n_clients
     pre = st.rafts
     gkeys = jax.vmap(lambda i: jax.random.fold_in(cluster_key, _S_GROUP + i))(
         jnp.arange(g)
     )
-    s = jax.vmap(functools.partial(step_cluster, cfg))(pre, gkeys)
+    s = jax.vmap(
+        functools.partial(step_cluster, cfg), in_axes=(0, 0, None)
+    )(pre, gkeys, kn)
     t = s.tick[0]  # all groups tick in lockstep
     key = jax.random.fold_in(cluster_key, t)
     viol = jnp.asarray(0, I32)
@@ -596,8 +670,9 @@ def shardkv_step(
             is_cfg[..., None] & gains,
             jnp.where(from_nobody, OWNED, PULLING), phase,
         )
-        if not kcfg.bug_skip_freeze:
-            phase = jnp.where(is_cfg[..., None] & loses, FROZEN, phase)
+        phase = jnp.where(
+            is_cfg[..., None] & loses & ~skn.bug_skip_freeze, FROZEN, phase
+        )
         node_cfg = jnp.where(is_cfg, cfg_c, node_cfg)
 
         # INSTALL(s, c): adopt the staged payload (group-level staging models
@@ -615,14 +690,12 @@ def shardkv_step(
         stg_count = st.staged_count[:, None, :] * jnp.ones((1, n, 1), I32)
         key_hash = jnp.where(inst_upd, stg_hash, key_hash)
         key_count = jnp.where(inst_upd, stg_count, key_count)
-        if kcfg.bug_drop_dup_table:
-            last_seq = jnp.where(inst_upd[..., None], 0, last_seq)
-        else:
-            last_seq = jnp.where(
-                inst_upd[..., None],
-                st.staged_last_seq[:, None, :, :] * jnp.ones((1, n, 1, 1), I32),
-                last_seq,
-            )
+        adopted = st.staged_last_seq[:, None, :, :] * jnp.ones((1, n, 1, 1), I32)
+        last_seq = jnp.where(
+            inst_upd[..., None],
+            jnp.where(skn.bug_drop_dup_table, 0, adopted),
+            last_seq,
+        )
         phase = jnp.where(inst_upd, OWNED, phase)
 
         # DELETE(s, c): drop the frozen copy (challenge-1 GC) — only at its
@@ -812,9 +885,9 @@ def shardkv_step(
         w = jax.random.bits(k, shape)
         lost = (
             (w >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
-        ) < kcfg.pull_loss
-        span = max(1, kcfg.pull_delay_max + 1 - kcfg.pull_delay_min)
-        delay = kcfg.pull_delay_min + ((w & 0xFF) % span).astype(I32)
+        ) < skn.pull_loss
+        span = jnp.maximum(1, skn.pull_delay_max + 1 - skn.pull_delay_min)
+        delay = skn.pull_delay_min + ((w & 0xFF) % span).astype(I32)
         return delay, lost
 
     # Deliver pull requests: src leader answers for FROZEN shards at the
@@ -898,7 +971,7 @@ def shardkv_step(
     # ------------------------------------------- leader protocol transitions
     # (a) poll the controller: append CONFIG(node_cfg+1) once migrations for
     #     the current config are complete (no PULLING shard at the leader).
-    poll = jax.random.bernoulli(kp[0], kcfg.p_cfg_learn, (g,))
+    poll = jax.random.bernoulli(kp[0], skn.p_cfg_learn, (g,))
     # Advance gate: all pulls for the current config done, AND no FROZEN
     # shard that the next config would hand back to us — its frozen copy
     # still serves the older migration; the DELETE (driven by our own
@@ -919,7 +992,7 @@ def shardkv_step(
     )
     # (b) pull requests for PULLING shards -> previous owner.
     want_pull = (l_phase == PULLING) & lead_any[:, None]  # [G(dst), NS]
-    pull_draw = jax.random.bernoulli(kp[1], kcfg.p_pull, (g, ns))
+    pull_draw = jax.random.bernoulli(kp[1], skn.p_pull, (g, ns))
     prev_owner_l = st.cfg_owner[jnp.clip(l_cfg - 1, 0, kcfg.n_configs - 1)]  # [G, NS]
     do_pull = want_pull & pull_draw
     tgt_oh = prev_owner_l[:, None, :] == my_gv[None, :, None]  # [dst, src, NS]
@@ -942,7 +1015,7 @@ def shardkv_step(
         ),
         axis=1,
     )  # [G, NS]: owner at the holder's freeze config
-    gc_draw = jax.random.bernoulli(kp[3], kcfg.p_ack, (g, ns))
+    gc_draw = jax.random.bernoulli(kp[3], skn.p_ack, (g, ns))
     do_gcq = (
         (l_phase == FROZEN) & (freeze_cfg > 0) & gc_draw & lead_any[:, None]
     )
@@ -982,13 +1055,13 @@ def shardkv_step(
     clerk_acked = jnp.where(newly, st.clerk_seq, st.clerk_acked)
     clerk_out = st.clerk_out & ~newly
     gets_done = st.gets_done + done_get.astype(I32)
-    learn = jax.random.bernoulli(kc[0], kcfg.p_cfg_learn, (nc,))
+    learn = jax.random.bernoulli(kc[0], skn.p_cfg_learn, (nc,))
     clerk_cfg = jnp.where(
         learn, active_cfg, st.clerk_cfg
     )
     start = (
         ~clerk_out
-        & jax.random.bernoulli(kc[1], kcfg.p_op, (nc,))
+        & jax.random.bernoulli(kc[1], skn.p_op, (nc,))
         & (st.clerk_seq < _SEQ_LIM - 1)
     )
     clerk_seq = jnp.where(start, st.clerk_seq + 1, st.clerk_seq)
@@ -1000,9 +1073,9 @@ def shardkv_step(
     clerk_kind = jnp.where(
         start,
         jnp.where(
-            u_kind < kcfg.p_get,
+            u_kind < skn.p_get,
             _GET,
-            jnp.where(u_kind < kcfg.p_get + kcfg.p_put, _PUT, _APPEND),
+            jnp.where(u_kind < skn.p_get + skn.p_put, _PUT, _APPEND),
         ),
         st.clerk_kind,
     )
@@ -1012,7 +1085,7 @@ def shardkv_step(
     clerk_get_lo = jnp.where(start, truth_at_new, st.clerk_get_lo)
     clerk_get_obs = jnp.where(start, -1, clerk_get_obs)
     clerk_out = clerk_out | start
-    retry = clerk_out & (start | jax.random.bernoulli(kc[3], kcfg.p_retry, (nc,)))
+    retry = clerk_out & (start | jax.random.bernoulli(kc[3], skn.p_retry, (nc,)))
     tgt_node = jax.random.randint(kc[4], (nc,), 0, n, dtype=I32)
 
     # ---------------------------- service-layer log appends (post-raft-tick)
@@ -1027,7 +1100,7 @@ def shardkv_step(
         ok = (
             mask_gn & s.alive
             & (log_len - s.base < cap)
-            & (log_len - s.commit < cfg.flow_cap)
+            & (log_len - s.commit < kn.flow_cap)
         )
         hit = ok[..., None] & (
             jnp.arange(cap, dtype=I32)[None, None, :]
@@ -1068,28 +1141,28 @@ def shardkv_step(
     # oracle must flag any observation below the invoke-time truth.
     owner_of = st.cfg_owner[jnp.clip(clerk_cfg, 0, kcfg.n_configs - 1)]  # [NC, NS]
     grp_c = jnp.sum(jnp.where(sh_oh_new, owner_of, 0), axis=1)  # [NC]
-    if kcfg.bug_serve_frozen:
-        sel4 = (
-            (gids_v[None, :, None, None] == grp_c[:, None, None, None])
-            & (me_n[None, None, :, None] == tgt_node[:, None, None, None])
-            & (sh_lane[None, None, None, :] == clerk_shard[:, None, None, None])
-        )  # [NC, G, N, NS]
-        ph_at = jnp.sum(jnp.where(sel4, phase[None], 0), axis=(1, 2, 3))
-        cnt_at = jnp.sum(jnp.where(sel4, key_count[None], 0), axis=(1, 2, 3))
-        alive_at = jnp.any(jnp.any(sel4, axis=-1) & s.alive[None], axis=(1, 2))
-        served = (
-            retry & ~start & (clerk_kind == _GET) & alive_at & (ph_at != OWNED)
-        )
-        viol |= jnp.where(
-            jnp.any(
-                served & ((cnt_at < clerk_get_lo) | (cnt_at > truth_at_new))
-            ),
-            VIOLATION_SHARD_STALE_READ, 0,
-        )
-        clerk_acked = jnp.where(served, clerk_seq, clerk_acked)
-        clerk_out = clerk_out & ~served
-        gets_done = gets_done + served.astype(I32)
-        retry = retry & ~served
+    sel4 = (
+        (gids_v[None, :, None, None] == grp_c[:, None, None, None])
+        & (me_n[None, None, :, None] == tgt_node[:, None, None, None])
+        & (sh_lane[None, None, None, :] == clerk_shard[:, None, None, None])
+    )  # [NC, G, N, NS]
+    ph_at = jnp.sum(jnp.where(sel4, phase[None], 0), axis=(1, 2, 3))
+    cnt_at = jnp.sum(jnp.where(sel4, key_count[None], 0), axis=(1, 2, 3))
+    alive_at = jnp.any(jnp.any(sel4, axis=-1) & s.alive[None], axis=(1, 2))
+    served = (
+        skn.bug_serve_frozen
+        & retry & ~start & (clerk_kind == _GET) & alive_at & (ph_at != OWNED)
+    )
+    viol |= jnp.where(
+        jnp.any(
+            served & ((cnt_at < clerk_get_lo) | (cnt_at > truth_at_new))
+        ),
+        VIOLATION_SHARD_STALE_READ, 0,
+    )
+    clerk_acked = jnp.where(served, clerk_seq, clerk_acked)
+    clerk_out = clerk_out & ~served
+    gets_done = gets_done + served.astype(I32)
+    retry = retry & ~served
 
     # Client ops at the believed owner's targeted node (leader-gated; a wrong
     # or stale guess commits nothing or a rejected entry — the clerk retries).
@@ -1169,6 +1242,52 @@ class ShardKvFuzzReport(NamedTuple):
         return np.nonzero((self.violations | self.raft_violations) != 0)[0]
 
 
+@functools.lru_cache(maxsize=None)
+def _shardkv_program(
+    static_cfg: SimConfig, static_kcfg: ShardKvConfig, n_clusters: int,
+    mesh: Optional[Mesh], per_cluster_knobs: bool = False,
+):
+    """One compiled program per static shape; every probability, interval,
+    and bug mode is a runtime knob (uniform scalars — the fast layout; the
+    per-cluster layout serves make_shardkv_sweep_fn). Before the knob split
+    this layer rebuilt an uncached jit closure per make_shardkv_fuzz_fn
+    call, recompiling for every (config, call site) pair."""
+    constraint = None
+    if mesh is not None:
+        constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
+    kn_ax = 0 if per_cluster_knobs else None
+
+    def run(seed, kn, skn, n_ticks) -> ShardKvState:
+        base = jax.random.PRNGKey(seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            jnp.arange(n_clusters)
+        )
+        states = jax.vmap(
+            functools.partial(init_shardkv_cluster, static_cfg, static_kcfg),
+            in_axes=(0, kn_ax, kn_ax),
+        )(keys, kn, skn)
+        if constraint is not None:
+            states = jax.lax.with_sharding_constraint(
+                states, jax.tree.map(lambda _: constraint, states)
+            )
+            keys = jax.lax.with_sharding_constraint(keys, constraint)
+            if per_cluster_knobs:
+                kn, skn = jax.tree.map(
+                    lambda x: jax.lax.with_sharding_constraint(x, constraint),
+                    (kn, skn),
+                )
+
+        def body(_, carry):
+            return jax.vmap(
+                functools.partial(shardkv_step, static_cfg, static_kcfg),
+                in_axes=(0, 0, kn_ax, kn_ax),
+            )(carry, keys, kn, skn)
+
+        return jax.lax.fori_loop(0, n_ticks, body, states)
+
+    return jax.jit(run)
+
+
 def make_shardkv_fuzz_fn(
     cfg: SimConfig,
     kcfg: ShardKvConfig,
@@ -1177,32 +1296,69 @@ def make_shardkv_fuzz_fn(
     mesh: Optional[Mesh] = None,
 ):
     """Build a jitted fn(seed) -> final batched ShardKvState."""
-    constraint = None
-    if mesh is not None:
-        constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
-
-    def run(seed) -> ShardKvState:
-        base = jax.random.PRNGKey(seed)
-        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-            jnp.arange(n_clusters)
-        )
-        states = jax.vmap(functools.partial(init_shardkv_cluster, cfg, kcfg))(keys)
-        if constraint is not None:
-            states = jax.lax.with_sharding_constraint(
-                states, jax.tree.map(lambda _: constraint, states)
-            )
-            keys = jax.lax.with_sharding_constraint(keys, constraint)
-
-        def body(carry, _):
-            nxt = jax.vmap(functools.partial(shardkv_step, cfg, kcfg))(carry, keys)
-            return nxt, None
-
-        final, _ = jax.lax.scan(body, states, None, length=n_ticks)
-        return final
-
-    prog = jax.jit(run)
+    _check_shardkv_cfg(cfg)
+    prog = _shardkv_program(cfg.static_key(), kcfg.static_key(), n_clusters,
+                            mesh)
+    kn = cfg.knobs()
+    skn = kcfg.knobs()
+    ticks = jnp.asarray(n_ticks, jnp.int32)
     # uint32 coercion: keep the (seed, cluster_id) replay contract under x64
-    return lambda seed: prog(jnp.asarray(seed, jnp.uint32))
+    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, skn, ticks)
+
+
+def _validate_shardkv_knobs(skn) -> None:
+    """Eager rejection of knob values that would silently misbehave inside
+    the compiled program (the engine._validate_knobs analogue)."""
+    from madraft_tpu.tpusim.engine import validate_bool_bugs, validate_probs
+
+    k = jax.tree.map(np.asarray, skn)
+    validate_probs(
+        k, ("p_op", "p_get", "p_put", "p_retry", "p_cfg_learn", "p_pull",
+            "p_ack", "pull_loss"), "shardkv",
+    )
+    if (k.p_get + k.p_put > 1.0).any():
+        raise ValueError("p_get + p_put must stay <= 1 per deployment")
+    if (k.pull_delay_max < k.pull_delay_min).any() or (
+        k.pull_delay_min < 1
+    ).any():
+        raise ValueError(
+            f"pull delay span empty: [{k.pull_delay_min}, {k.pull_delay_max}]"
+        )
+    if (k.cfg_interval < 2).any():
+        raise ValueError(f"cfg_interval must be >= 2: {k.cfg_interval}")
+    validate_bool_bugs(
+        k, ("bug_skip_freeze", "bug_drop_dup_table", "bug_serve_frozen"),
+        "shardkv",
+    )
+
+
+def make_shardkv_sweep_fn(
+    cfg: SimConfig,
+    knobs,   # config.Knobs, uniform or with leading [n_clusters] axes
+    sknobs,  # ShardKvKnobs, uniform or with leading [n_clusters] axes
+    kcfg: ShardKvConfig,
+    n_clusters: int,
+    n_ticks: int,
+    mesh: Optional[Mesh] = None,
+):
+    """Like make_shardkv_fuzz_fn, but every deployment runs its own raft AND
+    service knobs — reconfiguration cadence, workload mix, inter-group
+    network, and the planted migration bugs become per-deployment data."""
+    from madraft_tpu.tpusim.engine import (
+        _validate_knobs,
+        validate_service_raft_knobs,
+    )
+
+    _check_shardkv_cfg(cfg)
+    _validate_knobs(knobs)
+    validate_service_raft_knobs(knobs)
+    _validate_shardkv_knobs(sknobs)
+    prog = _shardkv_program(cfg.static_key(), kcfg.static_key(), n_clusters,
+                            mesh, per_cluster_knobs=True)
+    kn = knobs.broadcast(n_clusters)
+    skn = sknobs.broadcast(n_clusters)
+    ticks = jnp.asarray(n_ticks, jnp.int32)
+    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, skn, ticks)
 
 
 def shardkv_report(final: ShardKvState) -> ShardKvFuzzReport:

@@ -59,6 +59,20 @@ def test_ctrlerconfig_fields_all_reach_the_program():
         )
 
 
+def test_shardkvconfig_fields_all_reach_the_program():
+    from madraft_tpu.tpusim.shardkv import ShardKvConfig, ShardKvKnobs
+
+    static = {"n_groups", "n_shards", "n_clients", "n_configs",
+              "apply_max", "walk_max"}
+    knob_names = set(ShardKvKnobs._fields)
+    for f in dataclasses.fields(ShardKvConfig):
+        if f.name in static:
+            continue
+        assert f.name in knob_names, (
+            f"ShardKvConfig.{f.name} is neither static nor a knob"
+        )
+
+
 def test_sweep_knob_validation_rejects_bad_ranges():
     cfg = SimConfig()
     bad = cfg.replace(election_timeout_min=30, election_timeout_max=15).knobs()

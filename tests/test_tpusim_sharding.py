@@ -93,3 +93,27 @@ def test_sharded_service_sweeps_match_unsharded():
     )
     for x, y in zip(a, b):
         np.testing.assert_array_equal(x, y)
+
+    from madraft_tpu.tpusim.shardkv import (
+        ShardKvConfig,
+        make_shardkv_sweep_fn,
+        shardkv_report,
+    )
+
+    sk = ShardKvConfig(n_groups=2, n_configs=6)
+    cfg2 = SimConfig(
+        n_nodes=3, p_client_cmd=0.0, compact_at_commit=False,
+        log_cap=64, compact_every=16, loss_prob=0.05,
+    )
+    skn = sk.knobs()._replace(
+        cfg_interval=jnp.where(half, 40, 80).astype(jnp.int32)
+    )
+    a = shardkv_report(
+        make_shardkv_sweep_fn(cfg2, cfg2.knobs(), skn, sk, 16, 200)(9)
+    )
+    b = shardkv_report(
+        make_shardkv_sweep_fn(cfg2, cfg2.knobs(), skn, sk, 16, 200,
+                              mesh=_mesh())(9)
+    )
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)

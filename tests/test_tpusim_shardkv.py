@@ -253,3 +253,40 @@ def test_shardkv_serve_frozen_oracle_fires_with_puts():
     assert (
         rep.violations[rep.violating_clusters()] & VIOLATION_SHARD_STALE_READ
     ).any()
+
+
+def test_shardkv_sweep_per_deployment_knobs_and_bugs():
+    """The knob split landed on the sharded layer too: a uniform-valued
+    sweep reproduces the uniform program bit-for-bit, and a per-deployment
+    bug axis (drop_dup_table in the first half) lands every violation in
+    that half — migration cadence, workload, and bugs as data."""
+    import jax.numpy as jnp
+
+    from madraft_tpu.tpusim.shardkv import (
+        VIOLATION_SHARD_DIVERGE,
+        make_shardkv_sweep_fn,
+        shardkv_report,
+    )
+
+    n, ticks = 12, 900
+    kcfg = SKV.replace(p_retry=0.8, n_configs=10, cfg_interval=70)
+    fn = make_shardkv_sweep_fn(RAFT, RAFT.knobs(), kcfg.knobs(), kcfg, n,
+                               ticks)
+    rep_sweep = shardkv_report(
+        jax.block_until_ready(fn(jnp.asarray(5, jnp.uint32)))
+    )
+    rep_uni = shardkv_fuzz(RAFT, kcfg, seed=5, n_clusters=n, n_ticks=ticks)
+    for a, b in zip(rep_sweep, rep_uni):
+        np.testing.assert_array_equal(a, b)
+
+    half = jnp.arange(n) < n // 2
+    skn = kcfg.knobs()._replace(bug_drop_dup_table=half)
+    fn = make_shardkv_sweep_fn(RAFT, RAFT.knobs(), skn, kcfg, n, ticks)
+    rep = shardkv_report(jax.block_until_ready(fn(jnp.asarray(5, jnp.uint32))))
+    bugged = np.asarray(half)
+    viol = (rep.violations | rep.raft_violations) != 0
+    assert viol[bugged].any(), "bugged half produced no migration violation"
+    assert (rep.violations[bugged & viol] & VIOLATION_SHARD_DIVERGE).any()
+    assert not viol[~bugged].any(), (
+        f"clean half flagged: {rep.violations[~bugged & viol]}"
+    )
