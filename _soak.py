@@ -92,6 +92,20 @@ def grid_knobs(cfg: SimConfig, n: int):
     return cfg.knobs()._replace(loss_prob=loss, p_crash=crash, p_repartition=rep_p)
 
 
+def _checkpoint_partial(rows) -> None:
+    """After each region, persist what has run so far: two tunnel outages
+    this round killed soaks mid-run and left NO artifact for ~1e10 clean
+    steps. Written atomically (tmp + rename) so the abrupt kill this exists
+    to survive cannot half-write it; replaced by the final artifact on
+    success."""
+    path = os.environ.get("SOAK_OUT")
+    if path:
+        tmp = path + ".partial.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"regions": rows, "complete": False}, f, indent=1)
+        os.replace(tmp, path + ".partial")
+
+
 def drive(name, fn, steps_per_rep, target_steps, stats, seed0):
     """Re-invoke fn(seed) until target_steps; return the region row.
 
@@ -139,6 +153,10 @@ def main() -> None:
     t_start = time.time()
     rows = []
 
+    def run_region(*a, **kw):
+        rows.append(drive(*a, **kw))
+        _checkpoint_partial(rows)
+
     def raft_stats(f):
         return (np.asarray(f.violations),
                 int((np.asarray(f.shadow_len) > 0).sum()))
@@ -147,15 +165,15 @@ def main() -> None:
     nc, nt = 4096, 2048
     cfg = flagship()
     fn = make_fuzz_fn(cfg, nc, nt)
-    rows.append(drive(
+    run_region(
         "raft_flagship", fn, nc * nt, 6e9 * SCALE, raft_stats, seed0=1000,
-    ))
+    )
 
     # --- raft storm: ~2e9 steps --------------------------------------------
     fn = make_fuzz_fn(storm(), nc, nt)
-    rows.append(drive(
+    run_region(
         "raft_storm", fn, nc * nt, 2e9 * SCALE, raft_stats, seed0=2000,
-    ))
+    )
 
     # --- 7-node storm (topology diversity): ~1e9 steps ---------------------
     cfg7 = SimConfig(
@@ -164,15 +182,15 @@ def main() -> None:
         p_leader_part=0.01, p_asym_cut=0.02,
     )
     fn = make_fuzz_fn(cfg7, nc, nt)
-    rows.append(drive(
+    run_region(
         "raft_storm_7node", fn, nc * nt, 1e9 * SCALE, raft_stats, seed0=2500,
-    ))
+    )
 
     # --- knob grid (heterogeneous knobs, one program): ~1e9 steps ----------
     fn = make_sweep_fn(flagship(), grid_knobs(flagship(), nc), nc, nt)
-    rows.append(drive(
+    run_region(
         "raft_grid16", fn, nc * nt, 1e9 * SCALE, raft_stats, seed0=3000,
-    ))
+    )
 
     # --- kv service stack: ~5e8 steps --------------------------------------
     kcfg = flagship().replace(
@@ -180,24 +198,24 @@ def main() -> None:
     )
     nck, ntk = 1024, 1024
     fn = make_kv_fuzz_fn(kcfg, KvConfig(p_get=0.3, p_put=0.2), nck, ntk)
-    rows.append(drive(
+    run_region(
         "kv_fuzz", fn, nck * ntk, 5e8 * SCALE,
         lambda f: (np.asarray(f.raft.violations),
                    int((np.asarray(f.clerk_acked).sum(axis=-1) > 0).sum())),
         seed0=4000,
-    ))
+    )
 
     # --- ctrler (4A) service stack: ~2e8 steps ------------------------------
     ccfg = flagship().replace(
         p_client_cmd=0.0, compact_at_commit=False, log_cap=32, compact_every=8
     )
     fn = make_ctrler_fuzz_fn(ccfg, CtrlerConfig(), nck, ntk)
-    rows.append(drive(
+    run_region(
         "ctrler_fuzz", fn, nck * ntk, 2e8 * SCALE,
         lambda f: (np.asarray(f.raft.violations),
                    int((np.asarray(f.w_cfg_num) > 0).sum())),
         seed0=6000,
-    ))
+    )
 
     # --- shardkv service stack: ~2e8 group-cluster steps -------------------
     scfg = SimConfig(
@@ -212,10 +230,10 @@ def main() -> None:
         r = shardkv_report(f)  # service-level AND per-group raft violations
         return r.violations | r.raft_violations, int(r.installs.sum())
 
-    rows.append(drive(
+    run_region(
         "shardkv_fuzz", fn, ncs * nts * skcfg.n_groups, 2e8 * SCALE,
         skv_stats, seed0=5000,
-    ))
+    )
 
     total = sum(r["cluster_steps"] for r in rows)
     viol = sum(r["violating_clusters"] for r in rows)
@@ -233,6 +251,9 @@ def main() -> None:
     if path:
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
+        partial = path + ".partial"
+        if os.path.exists(partial):
+            os.unlink(partial)
     print(json.dumps(out), flush=True)
     sys.exit(1 if viol else 0)
 

@@ -127,11 +127,10 @@ def _validate_knobs(knobs) -> None:
     """Eagerly reject knob values that would silently misbehave inside the
     compiled program (mod-by-zero spans, out-of-range probabilities)."""
     k = jax.tree.map(np.asarray, knobs)
-    for name in ("loss_prob", "p_crash", "p_restart", "p_repartition",
-                 "p_heal", "p_leader_part", "p_asym_cut", "p_client_cmd"):
-        v = getattr(k, name)
-        if (v < 0).any() or (v > 1).any():
-            raise ValueError(f"knob {name} outside [0, 1]: {v}")
+    validate_probs(
+        k, ("loss_prob", "p_crash", "p_restart", "p_repartition", "p_heal",
+            "p_leader_part", "p_asym_cut", "p_client_cmd"), "raft",
+    )
     if (k.eto_max < k.eto_min).any() or (k.eto_min < 1).any():
         raise ValueError(f"election timeout span empty: [{k.eto_min}, {k.eto_max}]")
     if (k.delay_max < k.delay_min).any() or (k.delay_min < 1).any():
