@@ -5,7 +5,9 @@ Covers: a 16-combo loss x crash x repartition sweep in ONE compiled program;
 raft shape corners (3/4/5/7 nodes, ae_max 1..8, log_cap 32..128,
 compact_every 1..48, leader-targeted + asymmetric cuts); kv extremes
 (apply_max=1 backlog, 8 hot clients on 2 keys); ctrler extremes (hot clerks,
-wide gid universe, query-heavy, starved walker); shardkv topologies
+wide gid universe, query-heavy, starved walker); service sweeps
+(make_*_sweep_fn: a kv workload x loss grid and a half-bugged ctrler batch
+whose violations must localize exactly); shardkv topologies
 (2..4 groups, 4..10 shards, 3..5 nodes/group). Exits non-zero on any
 violation OR liveness anomaly (a config that stops committing / stalls its
 schedule), which is how round 3's response-starvation and GC-leak bugs were
@@ -98,6 +100,38 @@ for ct, ticks in [
     check(f"  progress ng={ct.n_gids} nc={ct.n_clients} am={ct.apply_max}",
           (rr.configs_created > 0).all() and rr.queries_done.sum() > 0,
           f"cfg0={int((rr.configs_created == 0).sum())}")
+
+# 3c. service sweeps: heterogeneous per-cluster knob matrices in one program
+# (the make_*_sweep_fn surface) — a workload x loss grid on kv and a
+# half-bugged ctrler batch whose violations must localize exactly
+from madraft_tpu.tpusim.ctrler import make_ctrler_sweep_fn, ctrler_report
+from madraft_tpu.tpusim.kv import make_kv_sweep_fn, kv_report
+
+n_sw = 64
+cell4 = np.arange(n_sw) // (n_sw // 4)
+kv_kn = kcfg_base.knobs()._replace(
+    loss_prob=jnp.asarray([0.0, 0.0, 0.3, 0.3], jnp.float32)[cell4])
+kv_skn = KvConfig().knobs()._replace(
+    p_get=jnp.asarray([0.0, 0.5, 0.0, 0.5], jnp.float32)[cell4])
+rr = kv_report(make_kv_sweep_fn(kcfg_base, kv_kn, kv_skn, KvConfig(),
+                                n_sw, 512)(99))
+lossless_acked = rr.acked_ops[cell4 < 2]
+check("kv sweep 2x2 loss x p_get", rr.n_violating == 0,
+      f"viol={rr.n_violating} acked={rr.acked_ops.mean():.0f}")
+check("  kv sweep liveness (lossless cells)", (lossless_acked > 0).all(),
+      f"zero={int((lossless_acked == 0).sum())}/{lossless_acked.size}")
+
+bugged = np.arange(n_sw) < n_sw // 2
+ct_skn = CtrlerConfig().knobs()._replace(
+    bug_greedy_rebalance=jnp.asarray(bugged))
+rr = ctrler_report(make_ctrler_sweep_fn(
+    ccfg_base, ccfg_base.knobs(), ct_skn, CtrlerConfig(), n_sw, 512)(99))
+vio = rr.violations != 0
+check("ctrler sweep bug localization",
+      bool(vio[bugged].any() and not vio[~bugged].any()),
+      f"bugged={int(vio[bugged].sum())} clean={int(vio[~bugged].sum())}")
+check("  ctrler sweep liveness", (rr.configs_created > 0).all(),
+      f"cfg0={int((rr.configs_created == 0).sum())}")
 
 # 4. shardkv shapes
 for g, ns, nodes in [(2, 4, 3), (4, 10, 3), (3, 10, 5)]:
