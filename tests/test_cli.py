@@ -96,6 +96,20 @@ def test_cli_sweep_grid():
     with pytest.raises(SystemExit):
         run(["sweep", "--clusters", "4", "--ticks", "16"])  # < cells
 
+    import jax
+
+    if len(jax.devices()) == 8:  # == : the 96/60 arithmetic assumes 8
+        # mesh-sharded sweep: identical cells, and the divisibility check
+        # runs on the truncated batch (12 cells x 8 devices -> 96 works,
+        # 120 truncates to 120 -> 10/cell -> 120 % 8 == 0 works, but 52
+        # truncates to 48 which divides 8 — use 60: 5/cell -> 60 % 8 != 0)
+        rc_m, out_m = run(["sweep", "--clusters", "96", "--ticks", "128",
+                           "--mesh"])
+        rc_u, out_u = run(["sweep", "--clusters", "96", "--ticks", "128"])
+        assert rc_m == rc_u == 0 and out_m == out_u
+        with pytest.raises(SystemExit, match="divide evenly"):
+            run(["sweep", "--clusters", "60", "--ticks", "16", "--mesh"])
+
 
 def test_cli_service_bug_flag():
     # the planted-bug library from the front door: each layer's bug fires
