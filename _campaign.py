@@ -145,5 +145,22 @@ for g, ns, nodes in [(2, 4, 3), (4, 10, 3), (3, 10, 5)]:
     check(f"  progress g={g} ns={ns}", (rr.final_cfg >= sk.n_configs - 3).all(),
           f"final={np.sort(rr.final_cfg).tolist()}")
 
+# 5. shardkv with the LIVE on-device controller (announce/query protocol
+# under a storm; shape-varied). Safety must hold and announces must resolve.
+for g, nodes in [(2, 3), (3, 5)]:
+    raft = SimConfig(n_nodes=nodes, p_client_cmd=0.0, compact_at_commit=False,
+                     log_cap=64, compact_every=16, loss_prob=0.1,
+                     p_crash=0.01, p_restart=0.2, max_dead=1,
+                     p_repartition=0.03, p_heal=0.08)
+    sk = ShardKvConfig(n_groups=g, n_configs=8, cfg_interval=45,
+                       p_get=0.3, p_put=0.2, live_ctrler=True, p_phantom=0.4)
+    rr = shardkv_fuzz(raft, sk, seed=91, n_clusters=10, n_ticks=900)
+    check(f"shardkv live-ctrler g={g} n={nodes}", rr.n_violating == 0,
+          f"viol={rr.n_violating} ann={rr.ann_resolved.min()}")
+    check(f"  live announces resolve g={g}", (rr.ann_resolved >= 3).all(),
+          f"ann={np.sort(rr.ann_resolved).tolist()}")
+    check(f"  live walker never stalls g={g}",
+          not rr.ctrl_walker_stalled.any(), "ctrl walker fell behind")
+
 print("CAMPAIGN DONE", "FAILURES:" if fails else "all clean", fails)
 raise SystemExit(1 if fails else 0)

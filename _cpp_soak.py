@@ -1,0 +1,93 @@
+"""C++ seed-breadth soak: the madsim MADSIM_TEST_NUM idiom at real breadth.
+
+The reference's workflow is many-seed reruns of the full suite
+(/root/reference/README.md:54-87: MADSIM_TEST_NUM reruns with derived
+seeds, MADSIM_TEST_CHECK_DETERMINISTIC double-runs). CI covers 2 seeds
+(ci.sh); this tool runs the full 70-test C++ suite across N seeds — each
+seed under the determinism double-run (every test executes twice and the
+trace hashes must match) — and records the evidence as an artifact the
+same shape as the TPU soak's regions.
+
+Usage:
+    python _cpp_soak.py [n_seeds] [seed_base]     # default 50 seeds from 7000
+    SOAK_OUT=SOAK_r04_cpp.json python _cpp_soak.py
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+
+def main() -> None:
+    n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+    seed_base = int(sys.argv[2]) if len(sys.argv) > 2 else 7000
+    here = os.path.dirname(os.path.abspath(__file__))
+    binary = os.path.join(here, "build", "madtpu_tests")
+    if not os.path.exists(binary):
+        sys.exit(f"build first: cmake -S cpp -B build -G Ninja && ninja -C build")
+
+    t0 = time.time()
+    failed = []
+    tests_per_seed = 0
+    for i in range(n_seeds):
+        seed = seed_base + i
+        env = dict(
+            os.environ,
+            MADTPU_TEST_SEED=str(seed),
+            MADTPU_TEST_CHECK_DETERMINISTIC="1",
+        )
+        try:
+            proc = subprocess.run(
+                [binary], env=env, capture_output=True, text=True,
+                timeout=600,
+            )
+        except subprocess.TimeoutExpired:
+            # a hung seed must cost one row, not the whole artifact (the
+            # same leaves-no-evidence failure mode the TPU soak's per-region
+            # checkpointing closed in round 3)
+            failed.append({"seed": seed, "rc": "timeout", "tail": []})
+            print(json.dumps(failed[-1]), flush=True)
+            continue
+        # the runner prints one "[ OK ]" line per test execution (each test
+        # runs twice under the determinism check) and no summary line;
+        # failures exit nonzero with a FAIL/hash-mismatch line
+        oks = len(re.findall(r"^\[ OK", proc.stdout, re.M))
+        bad = re.findall(
+            r"^.*(?:FAIL|mismatch|panic).*$", proc.stdout + proc.stderr, re.M
+        )
+        if proc.returncode != 0 or bad:
+            tail = (bad or proc.stdout.strip().splitlines()[-1:])[:3]
+            failed.append({"seed": seed, "rc": proc.returncode, "tail": tail})
+            print(json.dumps(failed[-1]), flush=True)
+        else:
+            tests_per_seed = max(tests_per_seed, oks // 2)
+        if (i + 1) % 10 == 0:
+            print(
+                f"# {i + 1}/{n_seeds} seeds, {len(failed)} failed, "
+                f"{time.time() - t0:.0f}s",
+                file=sys.stderr, flush=True,
+            )
+
+    out = {
+        "metric": "cpp_suite_seed_soak",
+        "region": "cpp_seeds",
+        "n_seeds": n_seeds,
+        "seed_base": seed_base,
+        "tests_per_seed": tests_per_seed,
+        "deterministic_double_run": True,
+        "failed_seeds": failed,
+        "wall_s": round(time.time() - t0, 1),
+    }
+    path = os.environ.get("SOAK_OUT")
+    if path:
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+    sys.exit(1 if failed else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Megakernel ceiling probe: turn PERF.md's "~2x upside bound" into a
+measured number, settling the whole-tick-Pallas question (round-3 verdict
+item 7).
+
+Any tick implementation — XLA-fused phases or a single hand-written Pallas
+megakernel — must at minimum read and write the whole cluster state once
+per tick (the mandatory-traffic floor; PERF.md "Roofline position"). This
+probe times exactly that floor: a one-pass elementwise traversal of the
+REAL flagship state pytree at the bench batch size, loop-inside-jit with
+donated buffers (the PERF.md tunnel methodology — one device call runs
+many passes so the ~63 ms tunnel latency amortizes away).
+
+The implied ceiling is `passes/s x clusters`: the step rate of a
+hypothetical tick that does nothing but the mandatory traffic at the
+bandwidth this chip actually grants us. If that ceiling is ~2x the real
+step rate (bench.py), a whole-tick megakernel — which must ALSO do the
+tick's arithmetic, PRNG, and oracle reductions inside the same pass —
+cannot reach even 2x, and the perf chapter closes with a measured number
+instead of an estimate.
+
+Usage (on the real chip): python _mega_probe.py [clusters] [passes]
+Prints one JSON line.
+"""
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madraft_tpu.tpusim import SimConfig, init_cluster, step_cluster
+
+
+def flagship() -> SimConfig:
+    return SimConfig(
+        n_nodes=5, p_client_cmd=0.2, loss_prob=0.1, p_crash=0.01,
+        p_restart=0.2, max_dead=2, p_repartition=0.02, p_heal=0.05,
+    )
+
+
+def touch(x):
+    """Elementwise read-modify-write that XLA cannot elide or constant-fold
+    across iterations (the scan carry makes each pass depend on the last)."""
+    if x.dtype == jnp.bool_:
+        return ~x
+    return x + jnp.ones((), x.dtype)
+
+
+def main() -> None:
+    n_clusters = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    passes = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    cfg = flagship()
+    base = jax.random.PRNGKey(0)
+    keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+        jnp.arange(n_clusters)
+    )
+    states = jax.vmap(functools.partial(init_cluster, cfg))(keys)
+    state_bytes = sum(x.nbytes for x in jax.tree.leaves(states))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def floor_pass(states):
+        def body(c, _):
+            return jax.tree.map(touch, c), None
+
+        out, _ = jax.lax.scan(body, states, None, length=passes)
+        return out
+
+    out = floor_pass(states)
+    _ = np.asarray(out.tick)  # sync
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        out = floor_pass(out)
+        _ = np.asarray(out.tick)
+        best = min(best, time.perf_counter() - t0)
+    gbps = 2 * state_bytes * passes / best / 1e9
+    ceiling = n_clusters * passes / best
+
+    # the real tick, same process, same methodology (direct comparison)
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def real_ticks(states):
+        def body(c, _):
+            return jax.vmap(functools.partial(step_cluster, cfg))(c, keys), None
+
+        out, _ = jax.lax.scan(body, states, None, length=passes)
+        return out
+
+    states2 = jax.vmap(functools.partial(init_cluster, cfg))(keys)
+    out2 = real_ticks(states2)
+    _ = np.asarray(out2.violations)
+    best2 = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        out2 = real_ticks(out2)
+        _ = np.asarray(out2.violations)
+        best2 = min(best2, time.perf_counter() - t0)
+    real = n_clusters * passes / best2
+
+    print(json.dumps({
+        "metric": "megakernel_ceiling_steps_per_sec",
+        "value": round(ceiling, 1),
+        "unit": "cluster-steps/s/chip",
+        "detail": {
+            "floor_pass_gbps": round(gbps, 1),
+            "state_bytes_per_cluster": state_bytes // n_clusters,
+            "real_steps_per_sec": round(real, 1),
+            "ceiling_over_real": round(ceiling / real, 2),
+            "n_clusters": n_clusters,
+            "passes": passes,
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

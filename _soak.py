@@ -149,6 +149,16 @@ def main() -> None:
         SCALE = float(sys.argv[1])
     if len(sys.argv) > 2:
         SEED_BASE = int(sys.argv[2])
+    # Bounded retry/backoff before touching the backend: the tunnel fails by
+    # hanging inside PJRT init (three outages in round 3), and a hung soak
+    # leaves no artifact at all. SOAK_PLATFORM=cpu skips the probe (CI smoke).
+    from madraft_tpu._platform import apply_platform, init_backend_with_retry
+
+    plat = apply_platform(os.environ.get("SOAK_PLATFORM"))
+    if plat != "cpu":
+        ok, detail = init_backend_with_retry(plat, attempts=6)
+        if not ok:
+            sys.exit(f"soak: backend init failed after retries: {detail}")
     dev = str(jax.devices()[0])
     t_start = time.time()
     rows = []
@@ -233,6 +243,16 @@ def main() -> None:
     run_region(
         "shardkv_fuzz", fn, ncs * nts * skcfg.n_groups, 2e8 * SCALE,
         skv_stats, seed0=5000,
+    )
+
+    # --- shardkv with the LIVE on-device controller: ~1e8 steps -----------
+    lkcfg = ShardKvConfig(p_put=0.2, live_ctrler=True, p_phantom=0.4,
+                          cfg_interval=40)
+    fn = make_shardkv_fuzz_fn(scfg, lkcfg, ncs, nts)
+    run_region(
+        "shardkv_live_ctrler", fn,
+        ncs * nts * (lkcfg.n_groups + 1),  # +1: the ctrler cluster ticks too
+        1e8 * SCALE, skv_stats, seed0=5500,
     )
 
     total = sum(r["cluster_steps"] for r in rows)

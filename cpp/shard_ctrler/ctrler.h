@@ -208,8 +208,13 @@ struct ShardInfo {
     // instead of gid — a per-replica permutation of the iteration order, the
     // batched backend's bug_rotate_tiebreak (ctrler.py). rot=0 = canonical.
     uint64_t rot = bug == 1 ? ctrl_rot() : 0;
+    // max gid + 1 wraps to 0 when a caller joins gid UINT64_MAX; a zero
+    // modulus would be UB in rkey. rot==0 needs no permutation at all, and
+    // under bug mode 1 the saturated modulus still permutes every real gid.
     uint64_t mod = c.groups.rbegin()->first + 1;
-    auto rkey = [&](Gid g) { return (g + rot) % mod; };
+    auto rkey = [&](Gid g) {
+      return (rot == 0 || mod == 0) ? g : (g + rot) % mod;
+    };
 
     std::map<Gid, size_t> count;
     for (auto& [gid, _] : c.groups) count[gid] = 0;

@@ -26,7 +26,8 @@
 // Schedule format (line-based; '#' comments):
 //   gids <NG>
 //   bug <none|rotate_tiebreak|greedy_rebalance|full_reshuffle>
-//   op join <gid> | op leave <gid> | op move <shard> <gid> | op query <num>
+//   op join <g0> [g1 ...] | op leave <g0> [g1 ...]   # 1..join_max gids
+//   op move <shard> <gid> | op query <num>
 //   expect_cfgs <n>
 //   expect_owner <o0> ... <o9>       # -1 = unowned (TPU gid index space)
 #pragma once
@@ -50,8 +51,11 @@ using shard_ctrler::N_SHARDS;
 using shard_ctrler::ShardInfo;
 
 struct OpLine {
-  int kind = 0;  // 0 join(a) / 1 leave(a) / 2 move(a=shard, b=gid) / 3 query(a=num)
+  int kind = 0;  // 0 join(set) / 1 leave(set) / 2 move(a=shard, b=gid)
+  //                3 query(a=num)
   uint64_t a = 0, b = 0;
+  std::vector<uint64_t> set;  // join/leave gid set (1..join_max gids — the
+  //                             TPU layer's multi-gid ops; msg.rs:20-37)
 };
 
 struct Schedule {
@@ -90,6 +94,23 @@ inline bool parse_schedule(FILE* f, Schedule* out) {
       // a truncated "op move <shard>" would silently replay move(_, gid 0)
       // — a different op stream reading as "TPU false positive"
       if (op.kind == 2 && got < 3) return false;
+      if (op.kind <= 1) {
+        // join/leave carry a variable-length gid set: re-scan past the
+        // keyword+kind and collect every remaining integer
+        const char* p = line;
+        for (int skip = 0; skip < 2 && *p; skip++) {
+          while (*p == ' ' || *p == '\t') p++;
+          while (*p && *p != ' ' && *p != '\t' && *p != '\n') p++;
+        }
+        char* end = nullptr;
+        for (;;) {
+          uint64_t v = std::strtoull(p, &end, 10);
+          if (end == p) break;
+          op.set.push_back(v);
+          p = end;
+        }
+        if (op.set.empty()) return false;
+      }
       out->ops.push_back(op);
     } else if (!std::strcmp(kw, "expect_cfgs")) {
       std::sscanf(line, "%*s %lld", &out->expect_cfgs);
@@ -150,12 +171,19 @@ inline std::string run_schedule(const Schedule& sch) {
   for (const auto& op : sch.ops) {
     CtrlOp c;
     switch (op.kind) {
-      case 0:
-        c = CtrlOp::join({{Gid(op.a) + 1, {simcore::Addr(op.a + 1)}}});
+      case 0: {
+        std::map<Gid, std::vector<simcore::Addr>> groups;
+        for (uint64_t g : op.set)
+          groups[Gid(g) + 1] = {simcore::Addr(g + 1)};
+        c = CtrlOp::join(std::move(groups));
         break;
-      case 1:
-        c = CtrlOp::leave({Gid(op.a) + 1});
+      }
+      case 1: {
+        std::vector<Gid> gids;
+        for (uint64_t g : op.set) gids.push_back(Gid(g) + 1);
+        c = CtrlOp::leave(std::move(gids));
         break;
+      }
       case 2:
         c = CtrlOp::move_(op.a, Gid(op.b) + 1);
         break;

@@ -35,11 +35,49 @@ def _storm(cfg):
 
 
 def _sim_config(args):
-    from madraft_tpu.tpusim import SimConfig
+    import sys
 
-    cfg = SimConfig(n_nodes=args.nodes)
-    if args.storm:
-        cfg = _storm(cfg)
+    from madraft_tpu.tpusim import SimConfig
+    from madraft_tpu.tpusim.config import storm_profiles
+
+    profiles = storm_profiles()
+    prof = getattr(args, "profile", "")
+    if prof:
+        cfg, rec_clusters, rec_ticks, _bugs = profiles[prof]
+        # the profile owns topology and fault knobs (--nodes/--storm do not
+        # apply); scale stays overridable, with a warning when it is below
+        # the validated demonstration scale
+        if args.storm:
+            print(
+                f"madtpu: warning: --storm is ignored — profile {prof!r} "
+                "defines the full fault storm", file=sys.stderr,
+            )
+        if args.bug and (args.clusters * args.ticks
+                         < rec_clusters * rec_ticks):
+            print(
+                f"madtpu: warning: profile {prof!r} demonstrated "
+                f"{args.bug!r} at --clusters {rec_clusters} --ticks "
+                f"{rec_ticks}; the current budget may be too small for the "
+                "bug to manifest", file=sys.stderr,
+            )
+    else:
+        cfg = SimConfig(n_nodes=args.nodes)
+        if args.storm:
+            cfg = _storm(cfg)
+        if args.bug:
+            # each bug needs its tuned storm; at generic settings the buggy
+            # branch often never executes and the report is bit-identical to
+            # the correct program's (round-3 verdict)
+            want = [
+                name for name, (_, _, _, bugs) in profiles.items()
+                if args.bug in bugs
+            ]
+            hint = f" (try --profile {want[0]})" if want else ""
+            print(
+                f"madtpu: warning: --bug {args.bug!r} without --profile — "
+                f"the bug may never manifest at these settings{hint}",
+                file=sys.stderr,
+            )
     if args.majority_override:
         cfg = cfg.replace(majority_override=args.majority_override)
     if args.bug:
@@ -208,8 +246,14 @@ def cmd_shardkv_fuzz(args):
     )
 
     kcfg = _with_service_bug(
-        ShardKvConfig(p_get=args.p_get, p_put=args.p_put), args.service_bug
+        ShardKvConfig(p_get=args.p_get, p_put=args.p_put,
+                      live_ctrler=args.live_ctrler), args.service_bug
     )
+    if args.service_bug == "stale_ctrler_read" and not args.live_ctrler:
+        raise SystemExit(
+            "--service-bug stale_ctrler_read needs --live-ctrler: the bug "
+            "lives in the query path to the on-device replicated controller"
+        )
 
     mesh = _mesh(args)
 
@@ -360,6 +404,16 @@ def main(argv=None) -> int:
                         help="raft-layer planted bug (config.py RAFT_BUGS: "
                              "commit_any_term | grant_any_vote | "
                              "forget_voted_for | no_truncate)")
+        sp.add_argument("--profile", default="",
+                        choices=["", "storm", "fig8", "revote"],
+                        help="tuned fault-storm preset (overrides --nodes "
+                             "and --storm); the scale each bug "
+                             "was demonstrated at: --profile fig8 --bug "
+                             "commit_any_term --clusters 1024 --ticks 1000; "
+                             "--profile revote --bug forget_voted_for "
+                             "--clusters 2048 --ticks 1000; --profile storm "
+                             "--bug grant_any_vote|no_truncate "
+                             "--clusters 256 --ticks 600")
 
     def fuzz_common(sp, clusters):
         common(sp, clusters)
@@ -406,6 +460,10 @@ def main(argv=None) -> int:
     service_common(sp, 64)
     sp.add_argument("--p-get", type=float, default=0.3)
     sp.add_argument("--p-put", type=float, default=0.2)
+    sp.add_argument("--live-ctrler", action="store_true",
+                    help="configs ride an on-device replicated controller "
+                         "raft cluster (announce/query protocol) instead of "
+                         "the schedule tensor")
     sp.set_defaults(fn=cmd_shardkv_fuzz)
 
     sp = sub.add_parser(
@@ -433,14 +491,14 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_bridge)
 
     args = p.parse_args(argv)
-    # must run before any backend init; also honored via MADTPU_PLATFORM
-    import os
+    # Must run before any backend init. Honors --platform > MADTPU_PLATFORM
+    # > JAX_PLATFORMS (re-asserted via jax.config because the container's
+    # startup hook force-registers the tunnel regardless of the env var),
+    # and fails fast with an actionable message — instead of hanging
+    # indefinitely inside PJRT init — when the tunnel is degraded.
+    from madraft_tpu._platform import require_backend_or_die
 
-    plat = args.platform or os.environ.get("MADTPU_PLATFORM")
-    if plat:
-        import jax
-
-        jax.config.update("jax_platforms", plat)
+    require_backend_or_die(args.platform)
     return args.fn(args)
 
 

@@ -519,7 +519,9 @@ class CtrlerSchedule:
     n_gids: int
     bug: str = "none"  # none | rotate_tiebreak | greedy_rebalance | full_reshuffle
     ops: list = dataclasses.field(default_factory=list)
-    # ("join", g) | ("leave", g) | ("move", shard, g) | ("query", num)
+    # ("join", g, ...) | ("leave", g, ...) | ("move", shard, g) |
+    # ("query", num) — join/leave carry 1..join_max gids (the TPU layer's
+    # multi-gid ops; the reference's Join takes a map, msg.rs:20-37)
     expect_cfgs: int = -1
     expect_owner: list = dataclasses.field(default_factory=list)
     violations: int = 0
@@ -621,20 +623,26 @@ def extract_ctrler_schedule(cfg, kcfg, seed: int, cluster_id: int,
             continue
         last_seq[client] = seq
         room = cfgs < kcfg.n_configs - 1
-        if kind == 0:  # Join
-            gid = arg % ng
-            if room and not member[gid]:
-                member[gid] = True
+        if kind == 0:  # Join: arg is a gid-set bitmask; effective iff it
+            # adds at least one new member (ctrler.py _apply_entry) — export
+            # only the genuinely-new gids so the C++ replay is independent
+            # of its join-of-existing-gid no-op behavior
+            gset = [g for g in range(ng)
+                    if (arg >> g) & 1 and not member[g]]
+            if room and gset:
+                for g in gset:
+                    member[g] = True
                 owner = rebal(member, owner)
                 cfgs += 1
-                sched.ops.append(("join", gid))
-        elif kind == 1:  # Leave
-            gid = arg % ng
-            if room and member[gid]:
-                member[gid] = False
+                sched.ops.append(("join", *gset))
+        elif kind == 1:  # Leave: effective iff it removes a present member
+            gset = [g for g in range(ng) if (arg >> g) & 1 and member[g]]
+            if room and gset:
+                for g in gset:
+                    member[g] = False
                 owner = rebal(member, owner)
                 cfgs += 1
-                sched.ops.append(("leave", gid))
+                sched.ops.append(("leave", *gset))
         elif kind == 2:  # Move
             shard, gid = arg // ng, arg % ng
             if room and member[gid]:

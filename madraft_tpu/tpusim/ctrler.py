@@ -62,7 +62,9 @@ Oracles (on-device reductions, sticky violation bits):
   across leader failover").
 
 Entry packing (i32 log values): ((client*SEQ_LIM + seq)*ARG_LIM + arg)*4
-+ kind + 1, kind in {JOIN, LEAVE, MOVE, QUERY}; arg = gid, gid, shard*NG+gid,
++ kind + 1, kind in {JOIN, LEAVE, MOVE, QUERY}; arg = gid-set bitmask (the
+reference's Join takes a MAP of groups and Leave a vec, msg.rs:20-37 —
+multi-gid ops carry up to ``join_max`` gids), gid-set bitmask, shard*NG+gid,
 or config num (ARG_LIM-1 = "latest").
 """
 
@@ -108,6 +110,9 @@ class CtrlerConfig:
     n_clients: int = 4
     n_configs: int = 24      # config-history capacity; mutations are rejected
     #                          once full (deterministically, on every replica)
+    join_max: int = 3        # gids per Join/Leave op (the reference's Join
+    #                          takes a MAP of groups, msg.rs:20-37; multijoin
+    #                          is fuzzed concurrently, tests.rs:216-237)
     p_op: float = 0.3        # idle clerk starts a fresh op
     p_query: float = 0.3     # fresh op is a Query with this probability,
     p_move: float = 0.1      # a Move with this one; else Join/Leave (one draw)
@@ -130,6 +135,11 @@ class CtrlerConfig:
             )
         if self.n_gids < 2 or self.n_gids > N_SHARDS:
             raise ValueError(f"n_gids must be in [2, {N_SHARDS}], got {self.n_gids}")
+        if self.join_max < 1 or self.join_max > self.n_gids:
+            raise ValueError(
+                f"join_max must be in [1, n_gids={self.n_gids}], "
+                f"got {self.join_max}"
+            )
         top = _pack(self, self.n_clients - 1, _SEQ_LIM - 1, self._arg_lim - 1,
                     _QUERY)
         if top >= NOOP_CMD:
@@ -140,8 +150,9 @@ class CtrlerConfig:
 
     @property
     def _arg_lim(self) -> int:
-        # gid | shard*NG+gid | config num (+1 for the "latest" sentinel)
-        return max(N_SHARDS * self.n_gids, self.n_configs + 1)
+        # gid-set bitmask | shard*NG+gid | config num (+1 for "latest")
+        return max(1 << self.n_gids, N_SHARDS * self.n_gids,
+                   self.n_configs + 1)
 
     def replace(self, **kw) -> "CtrlerConfig":
         return dataclasses.replace(self, **kw)
@@ -160,8 +171,8 @@ class CtrlerConfig:
     def static_key(self) -> "CtrlerConfig":
         return CtrlerConfig(
             n_gids=self.n_gids, n_clients=self.n_clients,
-            n_configs=self.n_configs, apply_max=self.apply_max,
-            walk_max=self.walk_max,
+            n_configs=self.n_configs, join_max=self.join_max,
+            apply_max=self.apply_max, walk_max=self.walk_max,
         )
 
 
@@ -358,16 +369,23 @@ def _apply_entry(kcfg: CtrlerConfig, kkn: CtrlerKnobs, tie_rot,
     last_seq = jnp.where(cl_oh & is_op, jnp.maximum(prev, seq), last_seq)
 
     room = cfg_num < ncfg - 1
-    gid_arg = jnp.clip(arg % ng, 0, ng - 1)
+    # Join/Leave arg is a gid-SET bitmask (the reference's Join takes a map
+    # of several groups and Leave a vec of gids, msg.rs:20-37); Move arg is
+    # shard*NG+gid as before. A Join is effective iff it adds at least one
+    # new member, a Leave iff it removes at least one — matching the C++
+    # backend's set semantics (ctrler.h CtrlOp::join/leave).
+    mask = ((arg >> jnp.arange(ng, dtype=I32)) & 1) > 0  # [NG]
+    mv_gid = jnp.clip(arg % ng, 0, ng - 1)
     mv_shard = jnp.clip(arg // ng, 0, N_SHARDS - 1)
-    mv_gid = gid_arg
-    g_oh = jnp.arange(ng, dtype=I32) == gid_arg
-    mem_at_arg = jnp.any(g_oh & member)
+    mv_oh = jnp.arange(ng, dtype=I32) == mv_gid
+    mem_at_mv = jnp.any(mv_oh & member)
 
-    do_join = fresh & (kind == _JOIN) & room & ~mem_at_arg
-    do_leave = fresh & (kind == _LEAVE) & room & mem_at_arg
-    new_member = jnp.where(g_oh, (member | do_join) & ~do_leave, member)
-    do_move = fresh & (kind == _MOVE) & room & mem_at_arg
+    do_join = fresh & (kind == _JOIN) & room & jnp.any(mask & ~member)
+    do_leave = fresh & (kind == _LEAVE) & room & jnp.any(mask & member)
+    new_member = jnp.where(
+        do_join, member | mask, jnp.where(do_leave, member & ~mask, member)
+    )
+    do_move = fresh & (kind == _MOVE) & room & mem_at_mv
     do_rebal = do_join | do_leave
 
     reb = _rebalance(ng, new_member, owner, tie_rot,
@@ -447,6 +465,12 @@ class CtrlerState(NamedTuple):
     w_hist: jax.Array       # i32 [NCFG]
     w_q_seq: jax.Array      # i32 [NC] seq of the walker's last Query per client
     w_q_obs: jax.Array      # i32 [NC] the walker's answer for it
+    # Sticky diagnostic: the walker needed an entry the shadow ring had
+    # already overwritten (commit burst > log_cap inside one walk budget).
+    # From that point the frontier freezes and the 4A oracles stand down;
+    # without this bit a stalled-oracle run is indistinguishable from a
+    # clean one (round-3 advisor finding).
+    w_stalled: jax.Array    # bool
 
 
 def _check_ctrler_cfg(cfg: SimConfig) -> None:
@@ -495,6 +519,7 @@ def init_ctrler_cluster(
         w_hist=hist0,
         w_q_seq=jnp.zeros((nc,), I32),
         w_q_obs=jnp.full((nc,), -1, I32),
+        w_stalled=jnp.asarray(False, jnp.bool_),
     )
 
 
@@ -615,10 +640,14 @@ def ctrler_step(
     w_q_seq, w_q_obs = ks.w_q_seq, ks.w_q_obs
     sh_abs = _lane_abs(s.shadow_base, cap)  # [cap]
     lane1 = jnp.arange(cap, dtype=I32)
+    w_stalled = ks.w_stalled
     for _ in range(kcfg.walk_max):
         canw = w_frontier < s.shadow_len
         posw = _slot(w_frontier + 1, cap)
         in_win = jnp.any((lane1 == posw) & (sh_abs == w_frontier + 1))
+        # Entry needed but already overwritten by ring wraparound: permanent
+        # (the ring never un-overwrites), so the flag is sticky.
+        w_stalled = w_stalled | (canw & ~in_win)
         canw = canw & in_win
         val = jnp.sum(jnp.where(lane1 == posw, s.shadow_val, 0))
         (w_member, w_owner, w_hist, w_cfg_num, w_last_seq,
@@ -667,7 +696,7 @@ def ctrler_step(
     queries_done = ks.queries_done + done_q.astype(I32)
 
     # start fresh ops / retry pending ones
-    kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 5)
+    kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 7)
     start = (
         ~clerk_out
         & jax.random.bernoulli(kk[0], ckn.p_op, (nc,))
@@ -687,21 +716,39 @@ def ctrler_step(
             ),
         ),
     )
-    # arg draws: gid for Join/Leave, (shard, gid) for Move from one randint;
-    # the Query num from its OWN randint over the full history range —
-    # deriving it from the Move-sized draw would truncate historical-query
-    # coverage whenever N_SHARDS*n_gids < n_configs+1 (small gid universes)
+    # arg draws: a gid-SET bitmask for Join/Leave (1..join_max gids — the
+    # reference fuzzes concurrent multijoins, tests.rs:216-237; duplicate
+    # draws collapse, so set sizes vary), (shard, gid) for Move from one
+    # randint; the Query num from its OWN randint over the full history
+    # range — deriving it from the Move-sized draw would truncate
+    # historical-query coverage whenever N_SHARDS*n_gids < n_configs+1
     raw = jax.random.randint(
         kk[1], (nc,), 0, N_SHARDS * kcfg.n_gids, dtype=I32
     )
     qnum = jax.random.randint(kk[4], (nc,), 0, kcfg.n_configs + 1, dtype=I32)
+    gsel = jax.random.randint(
+        kk[5], (nc, kcfg.join_max), 0, kcfg.n_gids, dtype=I32
+    )
+    gcnt = jax.random.randint(kk[6], (nc,), 1, kcfg.join_max + 1, dtype=I32)
+    gmask = jnp.any(
+        (jnp.arange(kcfg.n_gids, dtype=I32)[None, None, :]
+         == gsel[:, :, None])
+        & (jnp.arange(kcfg.join_max, dtype=I32)[None, :, None]
+           < gcnt[:, None, None]),
+        axis=1,
+    )  # [nc, NG]
+    mask_arg = jnp.sum(
+        gmask.astype(I32)
+        << jnp.arange(kcfg.n_gids, dtype=I32)[None, :],
+        axis=1,
+    )
     new_arg = jnp.where(
         new_kind == _QUERY,
         jnp.where(
             raw % 4 == 0, kcfg._arg_lim - 1,  # "latest" 25% of the time
             qnum,
         ),
-        jnp.where(new_kind == _MOVE, raw, raw % kcfg.n_gids),
+        jnp.where(new_kind == _MOVE, raw, mask_arg),
     )
     clerk_kind = jnp.where(start, new_kind, ks.clerk_kind)
     clerk_arg = jnp.where(start, new_arg, ks.clerk_arg)
@@ -773,6 +820,7 @@ def ctrler_step(
         w_hist=w_hist,
         w_q_seq=w_q_seq,
         w_q_obs=w_q_obs,
+        w_stalled=w_stalled,
     )
 
 
@@ -786,6 +834,7 @@ class CtrlerFuzzReport(NamedTuple):
     committed: np.ndarray             # committed log entries per cluster
     msg_count: np.ndarray
     snap_installs: np.ndarray
+    walker_stalled: np.ndarray        # bool: oracle coverage lost (see state)
 
     @property
     def n_violating(self) -> int:
@@ -912,6 +961,7 @@ def ctrler_report(final: CtrlerState) -> CtrlerFuzzReport:
         committed=np.asarray(final.raft.shadow_len),
         msg_count=np.asarray(final.raft.msg_count),
         snap_installs=np.asarray(final.raft.snap_install_count),
+        walker_stalled=np.asarray(final.w_stalled),
     )
 
 

@@ -86,6 +86,15 @@ VIOLATION_SHARD_STALE_READ = 1024  # a Get observed a count outside its
 #                                    invoke..return truth window (the sharded
 #                                    reads-linearizability oracle; kv.py's
 #                                    VIOLATION_STALE_READ across migration)
+VIOLATION_SHARD_CTRL_STALE = 32768  # live-ctrler mode: a group committed a
+#                                     CONFIG entry whose variant bit differs
+#                                     from the controller's first-committed
+#                                     announce — it adopted a config the
+#                                     controller never committed (the
+#                                     stale-read-of-the-ctrler bug; the
+#                                     reference's groups must only act on
+#                                     configs the ctrler's raft committed,
+#                                     server.rs:12-18)
 
 _SEQ_LIM = 1 << 13
 _BIG = 1 << 30
@@ -105,6 +114,8 @@ _S_PULL = 17
 _S_CLERK = 18
 _S_CFGGEN = 19
 _S_NET_PULL = 20
+_S_CTRL = 21         # live-ctrler raft cluster stream
+_S_ANN = 22          # announcer / phantom-announcer / query draws
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,6 +145,23 @@ class ShardKvConfig:
     #                             GC-confirm polls)
     apply_max: int = 4          # apply-machine entries per node per tick
     walk_max: int = 6           # truth-walker entries per group per tick
+    # --- live replicated controller (STATIC: adds a raft cluster) ---
+    # When set, config ANNOUNCE entries ride a real on-device raft cluster
+    # (the controller the reference's servers poll, server.rs:12-18) and
+    # groups learn configs via Query request/response mailboxes to random
+    # controller nodes over the lossy inter-group network — config
+    # visibility races (two groups seeing different "latest" configs
+    # because their reads race a ctrler leader change) arise from the
+    # protocol, not from a shared truth tensor. Config CONTENT stays the
+    # pre-drawn schedule (the reference's tests script the Join/Leave
+    # sequence too; 4A content correctness is ctrler.py's province); what
+    # races is the committed ORDER of two competing announce variants —
+    # the truth announcer vs a "phantom" (the losing operation order of
+    # concurrent Join/Leave proposals). The first-committed variant IS the
+    # controller's decision; a group must only ever adopt that one.
+    live_ctrler: bool = False
+    p_announce: float = 0.5     # truth announcer submits this tick
+    p_phantom: float = 0.3      # phantom announcer submits this tick
     # Oracle-validation bug modes (False = correct service).
     bug_skip_freeze: bool = False    # lost shards keep serving at the nodes
     bug_drop_dup_table: bool = False  # INSTALL resets the migrated dup table
@@ -143,6 +171,12 @@ class ShardKvConfig:
     #                                  (a FROZEN surrendered copy, or nothing
     #                                  after GC) — the sharded stale-read bug
     #                                  the interval oracle must catch
+    bug_stale_ctrler_read: bool = False  # live-ctrler mode: a queried ctrler
+    #                                  node answers from its LOG TAIL
+    #                                  (uncommitted entries included) instead
+    #                                  of its committed prefix — a group can
+    #                                  adopt a phantom announce that raft
+    #                                  later rolls back; CTRL_STALE must fire
 
     def __post_init__(self):
         if self.p_get + self.p_put > 1.0:
@@ -175,20 +209,26 @@ class ShardKvConfig:
             pull_delay_min=jnp.int32(self.pull_delay_min),
             pull_delay_max=jnp.int32(self.pull_delay_max),
             pull_loss=jnp.float32(self.pull_loss),
+            p_announce=jnp.float32(self.p_announce),
+            p_phantom=jnp.float32(self.p_phantom),
             bug_skip_freeze=jnp.bool_(self.bug_skip_freeze),
             bug_drop_dup_table=jnp.bool_(self.bug_drop_dup_table),
             bug_serve_frozen=jnp.bool_(self.bug_serve_frozen),
+            bug_stale_ctrler_read=jnp.bool_(self.bug_stale_ctrler_read),
         )
 
     def static_key(self) -> "ShardKvConfig":
         """Only the shape-determining fields; everything else rides in
         ShardKvKnobs, so configs differing in probabilities, intervals, or
         bug modes share ONE compiled program (the config.py design, landed
-        on this layer last — it previously recompiled per config)."""
+        on this layer last — it previously recompiled per config).
+        ``live_ctrler`` is static: it adds a whole raft cluster plus the
+        announce/query machinery to the program."""
         return ShardKvConfig(
             n_groups=self.n_groups, n_shards=self.n_shards,
             n_clients=self.n_clients, n_configs=self.n_configs,
             apply_max=self.apply_max, walk_max=self.walk_max,
+            live_ctrler=self.live_ctrler,
         )
 
 
@@ -207,9 +247,12 @@ class ShardKvKnobs(NamedTuple):
     pull_delay_min: jax.Array
     pull_delay_max: jax.Array
     pull_loss: jax.Array
+    p_announce: jax.Array
+    p_phantom: jax.Array
     bug_skip_freeze: jax.Array
     bug_drop_dup_table: jax.Array
     bug_serve_frozen: jax.Array
+    bug_stale_ctrler_read: jax.Array
 
     def broadcast(self, n_clusters: int) -> "ShardKvKnobs":
         return ShardKvKnobs(
@@ -222,8 +265,12 @@ def _pack_op(cfg: ShardKvConfig, client, seq, shard, kind):
     return (((client * _SEQ_LIM + seq) * cfg.n_shards + shard) * 8 + kind) + 1
 
 
-def _pack_config(cfg_idx):
-    return (cfg_idx * 8 + _CONFIG) + 1
+def _pack_config(cfg_idx, var=0):
+    """CONFIG payload = cfg_idx*2 + variant bit. The variant records WHICH
+    committed announce the group adopted (live-ctrler mode; always 0 when
+    the controller is the schedule tensor) — the walker checks it against
+    the controller's first-committed variant (VIOLATION_SHARD_CTRL_STALE)."""
+    return ((cfg_idx * 2 + var) * 8 + _CONFIG) + 1
 
 
 def _pack_install(cfg: ShardKvConfig, cfg_idx, shard):
@@ -235,7 +282,8 @@ def _pack_delete(cfg: ShardKvConfig, cfg_idx, shard):
 
 
 def _unpack(cfg: ShardKvConfig, val):
-    """-> (kind, client, seq, shard, cfg_idx); fields valid per kind."""
+    """-> (kind, client, seq, shard, cfg_idx_c, cfg_idx_i, var_c); fields
+    valid per kind (var_c: the CONFIG entry's adopted-announce variant)."""
     v = val - 1
     kind = v % 8
     payload = v // 8
@@ -243,9 +291,10 @@ def _unpack(cfg: ShardKvConfig, val):
     cs = payload // cfg.n_shards
     client = cs // _SEQ_LIM
     seq = cs % _SEQ_LIM
-    cfg_idx_c = payload  # CONFIG payload
+    cfg_idx_c = payload // 2  # CONFIG payload
+    var_c = payload % 2
     cfg_idx_i = payload // cfg.n_shards  # INSTALL/DELETE payload
-    return kind, client, seq, shard, cfg_idx_c, cfg_idx_i
+    return kind, client, seq, shard, cfg_idx_c, cfg_idx_i, var_c
 
 
 class ShardKvState(NamedTuple):
@@ -255,6 +304,26 @@ class ShardKvState(NamedTuple):
     # --- controller schedule (drawn at init, constant thereafter) ---
     cfg_tick: jax.Array          # i32 [NCFG] activation tick of config j
     cfg_owner: jax.Array         # i32 [NCFG, NS] owning group per shard
+    # --- live replicated controller (kcfg.live_ctrler; zeros when off) ---
+    ctrl: ClusterState           # the controller's own raft cluster [N]
+    ctrl_w_frontier: jax.Array   # i32: walker cursor on the ctrl shadow log
+    ctrl_w_stalled: jax.Array    # bool, sticky: the walker needed a shadow
+    #                              entry the ring had overwritten — win_var
+    #                              stops resolving and the CTRL_STALE oracle
+    #                              silently stands down without this flag
+    #                              (the ctrler.py w_stalled pattern)
+    win_var: jax.Array           # i32 [NCFG]: first-committed announce's
+    #                              variant per config; -1 = not yet committed.
+    #                              THIS is the controller's decision — the
+    #                              committed winner of the truth-vs-phantom
+    #                              announce race.
+    cq_req_t: jax.Array          # i32 [G] query delivery tick (0 = none)
+    cq_req_node: jax.Array       # i32 [G] targeted ctrler node
+    cq_req_j: jax.Array          # i32 [G] asked config index
+    cq_rsp_t: jax.Array          # i32 [G] response delivery tick (0 = none)
+    cq_rsp_j: jax.Array          # i32 [G]
+    cq_rsp_found: jax.Array      # bool [G]
+    cq_rsp_var: jax.Array        # i32 [G]
     # --- per-node service state (volatile; rebuilt by log replay) ---
     applied: jax.Array           # i32 [G, N] apply cursor (absolute)
     node_cfg: jax.Array          # i32 [G, N] highest config applied
@@ -440,10 +509,34 @@ def init_shardkv_cluster(
     ) * jnp.ones((g, n, ns), I32)
     zgns = jnp.zeros((g, n, ns), I32)
     zggs = jnp.zeros((g, g, ns), I32)
+    if kcfg.live_ctrler:
+        ctrl = init_cluster(cfg, jax.random.fold_in(key, _S_CTRL), kn)
+    else:
+        # the mode is off (a STATIC choice — its own compiled program):
+        # carry the smallest legal ClusterState instead of a full dead
+        # cluster; shardkv throughput sits at the HBM working-set knee
+        # (bench.py), so an unused n-node cluster per deployment is real
+        # bandwidth
+        ctrl = init_cluster(
+            cfg.replace(n_nodes=1, log_cap=4, uncommitted_cap=1,
+                        compact_every=1),
+            jax.random.fold_in(key, _S_CTRL),
+        )
     return ShardKvState(
         rafts=rafts,
         cfg_tick=cfg_tick,
         cfg_owner=cfg_owner,
+        ctrl=ctrl,
+        ctrl_w_frontier=jnp.asarray(0, I32),
+        ctrl_w_stalled=jnp.asarray(False, jnp.bool_),
+        win_var=jnp.full((kcfg.n_configs,), -1, I32).at[0].set(0),
+        cq_req_t=jnp.zeros((g,), I32),
+        cq_req_node=jnp.zeros((g,), I32),
+        cq_req_j=jnp.zeros((g,), I32),
+        cq_rsp_t=jnp.zeros((g,), I32),
+        cq_rsp_j=jnp.zeros((g,), I32),
+        cq_rsp_found=jnp.zeros((g,), jnp.bool_),
+        cq_rsp_var=jnp.zeros((g,), I32),
         applied=jnp.zeros((g, n), I32),
         node_cfg=jnp.zeros((g, n), I32),
         phase=phase0,
@@ -519,6 +612,83 @@ def shardkv_step(
     viol = jnp.asarray(0, I32)
 
     active_cfg = jnp.sum((st.cfg_tick <= t).astype(I32)) - 1  # controller's view
+
+    # ------------------------------------------- live replicated controller
+    # (kcfg.live_ctrler) The ANNOUNCE(j, variant) stream rides a real raft
+    # cluster under the same fault storm as the groups. Two announcers race:
+    # truth (variant 0) and phantom (variant 1 — the losing operation order
+    # of concurrent Join/Leave proposals); whichever commits FIRST for a
+    # given j is the controller's decision. The walker below resolves the
+    # winner from the committed shadow log; groups may only ever adopt that
+    # winner (VIOLATION_SHARD_CTRL_STALE otherwise). The reference's servers
+    # poll this service via a ctrl-plane clerk (shardkv/server.rs:12-18).
+    ctrl = st.ctrl
+    win_var = st.win_var
+    ctrl_w_frontier = st.ctrl_w_frontier
+    ctrl_w_stalled = st.ctrl_w_stalled
+    ncfgs = kcfg.n_configs
+    if kcfg.live_ctrler:
+        ctrl = step_cluster(
+            cfg, st.ctrl, jax.random.fold_in(cluster_key, _S_CTRL), kn
+        )
+        lane1 = jnp.arange(cap, dtype=I32)
+        csh_abs = _lane_abs(ctrl.shadow_base, cap)  # [cap]
+        for _ in range(kcfg.walk_max):
+            canw = ctrl_w_frontier < ctrl.shadow_len
+            posw = _slot(ctrl_w_frontier + 1, cap)
+            in_win = jnp.any(
+                (lane1 == posw) & (csh_abs == ctrl_w_frontier + 1)
+            )
+            ctrl_w_stalled = ctrl_w_stalled | (canw & ~in_win)
+            canw = canw & in_win
+            val = jnp.sum(jnp.where(lane1 == posw, ctrl.shadow_val, 0))
+            is_ann = canw & (val > 0) & (val != NOOP_CMD)
+            aj = jnp.clip((val - 1) // 2, 0, ncfgs - 1)
+            av = (val - 1) % 2
+            j_oh = jnp.arange(ncfgs, dtype=I32) == aj
+            win_var = jnp.where(
+                j_oh & is_ann & (win_var < 0), av, win_var
+            )
+            ctrl_w_frontier = jnp.where(canw, ctrl_w_frontier + 1, ctrl_w_frontier)
+        # announces resolve in j order (announcers wait for j-1), so the
+        # committed frontier is the resolved prefix length - 1
+        frontier = jnp.sum(jnp.cumprod((win_var >= 0).astype(I32))) - 1
+        # the committed frontier replaces the schedule tensor as "the
+        # controller's view" for clerk visibility and the lag metric
+        active_cfg = frontier
+
+        # announcers: submit ANNOUNCE(frontier+1, var) to a random node that
+        # believes it is the leader, once the schedule says the config is
+        # due. A stale minority leader accepts the entry into its log (the
+        # phantom's home until raft rolls it back); only the majority
+        # leader's copy commits.
+        ka = jax.random.split(jax.random.fold_in(key, _S_ANN), 6)
+        jnext = jnp.clip(frontier + 1, 0, ncfgs - 1)
+        due = jnp.sum(
+            jnp.where(jnp.arange(ncfgs, dtype=I32) == jnext, st.cfg_tick, 0)
+        ) <= t
+        can_ann = (frontier + 1 < ncfgs) & due
+        c_term, c_val, c_len = ctrl.log_term, ctrl.log_val, ctrl.log_len
+        me_cn = jnp.arange(n, dtype=I32)
+        for var_bit, p_sub, kd, kt_ in (
+            (0, skn.p_announce, ka[0], ka[1]),
+            (1, skn.p_phantom, ka[2], ka[3]),
+        ):
+            sub = can_ann & jax.random.bernoulli(kd, p_sub)
+            tgt = jax.random.randint(kt_, (), 0, n, dtype=I32)
+            ok = (
+                (me_cn == tgt) & sub & ctrl.alive & (ctrl.role == LEADER)
+                & (c_len - ctrl.base < cap)
+                & (c_len - ctrl.commit < kn.flow_cap)
+            )
+            av_ = jnext * 2 + var_bit + 1
+            hit = ok[:, None] & (
+                lane1[None, :] == _slot(c_len + 1, cap)[:, None]
+            )
+            c_term = jnp.where(hit, ctrl.term[:, None], c_term)
+            c_val = jnp.where(hit, av_, c_val)
+            c_len = jnp.where(ok, c_len + 1, c_len)
+        ctrl = ctrl._replace(log_term=c_term, log_val=c_val, log_len=c_len)
 
     applied, node_cfg, phase = st.applied, st.node_cfg, st.phase
     key_hash, key_count, last_seq = st.key_hash, st.key_count, st.last_seq
@@ -609,7 +779,7 @@ def shardkv_step(
         can = s.alive & (applied < s.commit)  # [G, N]
         pos = _slot(applied + 1, cap)
         val = jnp.sum(jnp.where(lane == pos[..., None], s.log_val, 0), axis=-1)
-        kind, client, seq, shard, cfg_c, cfg_i = _unpack(kcfg, val)
+        kind, client, seq, shard, cfg_c, cfg_i, _var = _unpack(kcfg, val)
         client = jnp.clip(client, 0, nc - 1)
         sh_oh = sh_lane[None, None, :] == shard[..., None]          # [G,N,NS]
         cl_oh = cl_lane[None, None, :] == client[..., None]          # [G,N,NC]
@@ -738,7 +908,7 @@ def shardkv_step(
             jnp.where(lane_g == posw[:, None], s.shadow_val, 0), axis=1
         )
         canw = canw & in_win
-        kind, client, seq, shard, cfg_c, cfg_i = _unpack(kcfg, val)
+        kind, client, seq, shard, cfg_c, cfg_i, var_c = _unpack(kcfg, val)
         client = jnp.clip(client, 0, nc - 1)
         sh_oh = sh_lane[None, :] == shard[:, None]   # [G, NS]
         cl_oh = cl_lane[None, :] == client[:, None]  # [G, NC]
@@ -757,6 +927,26 @@ def shardkv_step(
             & (frz_at != cfg_i)
         )
         canw = canw & ~stall
+        # Live-ctrler oracle: the committed CONFIG entry's adopted-announce
+        # variant must equal the controller's first-committed one. A group
+        # that adopted a phantom (or an uncommitted announce, win_var still
+        # -1) acted on a config the controller never committed.
+        if kcfg.live_ctrler:
+            wv_at = jnp.sum(
+                jnp.where(
+                    jnp.arange(ncfgs, dtype=I32)[None, :]
+                    == jnp.clip(cfg_c, 0, ncfgs - 1)[:, None],
+                    win_var[None, :], 0,
+                ),
+                axis=1,
+            )
+            stale_adopt = (
+                canw & (kind == _CONFIG) & (cfg_c == w_cfg + 1)
+                & (var_c != wv_at)
+            )
+            viol |= jnp.where(
+                jnp.any(stale_adopt), VIOLATION_SHARD_CTRL_STALE, 0
+            )
         prev_seq = jnp.sum(
             jnp.where(sh_oh[..., None] & cl_oh[:, None, :], w_last_seq, 0),
             axis=(-2, -1),
@@ -876,7 +1066,7 @@ def shardkv_step(
     l_last_seq = lead_view(last_seq)  # [G, NS, NC]
 
     kp = jax.random.split(jax.random.fold_in(key, _S_PULL), 4)
-    knet = jax.random.split(jax.random.fold_in(key, _S_NET_PULL), 4)
+    knet = jax.random.split(jax.random.fold_in(key, _S_NET_PULL), 6)
 
     def _net_pair(k, shape):
         """(delay, lost) for a batch of inter-group sends from ONE u32 word
@@ -984,12 +1174,102 @@ def shardkv_step(
     regain_blocked = jnp.any(
         (l_phase == FROZEN) & (next_owner_l == my_gv[:, None]), axis=1
     )
-    can_advance = (
-        lead_any & poll
-        & (l_cfg < active_cfg)
+    gate_advance = (
+        lead_any
         & ~jnp.any(l_phase == PULLING, axis=1)
         & ~regain_blocked
     )
+    adopt_var = jnp.zeros((g,), I32)
+    cq_req_t, cq_req_node = st.cq_req_t, st.cq_req_node
+    cq_req_j = st.cq_req_j
+    cq_rsp_t, cq_rsp_j = st.cq_rsp_t, st.cq_rsp_j
+    cq_rsp_found, cq_rsp_var = st.cq_rsp_found, st.cq_rsp_var
+    if kcfg.live_ctrler:
+        # Query protocol to the live controller: one outstanding Query per
+        # group, request and response each paying a lossy delayed hop. The
+        # group adopts config j = l_cfg+1 when a response says the announce
+        # exists — a race: another group's response, a ctrler leader change,
+        # or a restart may have shifted the ground under it.
+        rsp_arr = cq_rsp_t == t
+        adopt = rsp_arr & cq_rsp_found & (cq_rsp_j == l_cfg + 1)
+        can_advance = gate_advance & adopt
+        adopt_var = jnp.where(adopt, cq_rsp_var, 0)
+        cq_rsp_t = jnp.where(rsp_arr, 0, cq_rsp_t)
+        # deliver requests at ctrler nodes; an ALIVE node answers from its
+        # committed prefix (follower answers model the reference's stale
+        # reads of a lagging replica — safe: committed data is monotone),
+        # or, under bug_stale_ctrler_read, from its raw LOG TAIL where a
+        # phantom announce may sit until raft rolls it back.
+        req_arr = cq_req_t == t
+        node_oh = me_n[None, :] == cq_req_node[:, None]  # [G, N]
+        csh_abs2 = _lane_abs(ctrl.shadow_base, cap)      # [cap]
+        ann_in_win = (
+            (ctrl.shadow_val > 0) & (ctrl.shadow_val != NOOP_CMD)
+            & (csh_abs2 <= ctrl.shadow_len)
+        )
+        above = jnp.sum(
+            ann_in_win[None, :] & (csh_abs2[None, :] > ctrl.commit[:, None]),
+            axis=1,
+        )  # [N]: committed announces this node has not yet covered
+        cnt_node = jnp.clip(frontier - above, 0, frontier)  # [N]
+        cnt_at = jnp.sum(jnp.where(node_oh, cnt_node[None, :], 0), axis=1)
+        jreq = cq_req_j
+        j_ohg = (
+            jnp.arange(ncfgs, dtype=I32)[None, :]
+            == jnp.clip(jreq, 0, ncfgs - 1)[:, None]
+        )
+        wv_req = jnp.sum(jnp.where(j_ohg, win_var[None, :], 0), axis=1)
+        found_ok = (jreq <= cnt_at) & (wv_req >= 0)
+        labs = _lane_abs(ctrl.base, cap)                 # [N, cap]
+        lval = ctrl.log_val
+        is_ann_l = (
+            (lval > 0) & (lval != NOOP_CMD)
+            & (labs <= ctrl.log_len[:, None])
+        )
+        lj = (lval - 1) // 2
+        lv = (lval - 1) % 2
+        m = (
+            node_oh[:, :, None] & is_ann_l[None, :, :]
+            & (lj[None, :, :] == jreq[:, None, None])
+        )  # [G, N, cap]
+        has_tail = jnp.any(m, axis=(1, 2))
+        amin = jnp.min(
+            jnp.where(m, labs[None, :, :], _BIG), axis=(1, 2)
+        )  # the node's FIRST log occurrence of announce j
+        var_tail = jnp.sum(
+            jnp.where(
+                m & (labs[None, :, :] == amin[:, None, None]),
+                lv[None, :, :], 0,
+            ),
+            axis=(1, 2),
+        )
+        found_rep = jnp.where(
+            skn.bug_stale_ctrler_read, has_tail | found_ok, found_ok
+        )
+        var_rep = jnp.where(
+            skn.bug_stale_ctrler_read & has_tail,
+            var_tail, jnp.maximum(wv_req, 0),
+        )
+        alive_at = jnp.any(node_oh & ctrl.alive[None, :], axis=1)
+        rdelay, rlost = _net_pair(knet[4], (g,))
+        send_rsp2 = req_arr & alive_at & ~rlost
+        cq_rsp_t = jnp.where(send_rsp2, t + rdelay, cq_rsp_t)
+        cq_rsp_j = jnp.where(send_rsp2, jreq, cq_rsp_j)
+        cq_rsp_found = jnp.where(send_rsp2, found_rep, cq_rsp_found)
+        cq_rsp_var = jnp.where(send_rsp2, var_rep, cq_rsp_var)
+        cq_req_t = jnp.where(req_arr, 0, cq_req_t)
+        # fresh queries from idle groups (a lost request or a dead node
+        # leaves the group idle again — it simply re-polls)
+        idle = (cq_req_t == 0) & (cq_rsp_t == 0)
+        ask = lead_any & poll & idle & (l_cfg + 1 < ncfgs)
+        tgtq = jax.random.randint(ka[4], (g,), 0, n, dtype=I32)
+        qdelay, qlost = _net_pair(knet[5], (g,))
+        sendq = ask & ~qlost
+        cq_req_t = jnp.where(sendq, t + qdelay, cq_req_t)
+        cq_req_node = jnp.where(sendq, tgtq, cq_req_node)
+        cq_req_j = jnp.where(sendq, l_cfg + 1, cq_req_j)
+    else:
+        can_advance = gate_advance & poll & (l_cfg < active_cfg)
     # (b) pull requests for PULLING shards -> previous owner.
     want_pull = (l_phase == PULLING) & lead_any[:, None]  # [G(dst), NS]
     pull_draw = jax.random.bernoulli(kp[1], skn.p_pull, (g, ns))
@@ -1111,8 +1391,9 @@ def shardkv_step(
         log_len = jnp.where(ok, log_len + 1, log_len)
         return log_term, log_val, log_len
 
-    # CONFIG advance at the (single chosen) leader node.
-    cfg_val = _pack_config(node_cfg + 1)  # [G, N]
+    # CONFIG advance at the (single chosen) leader node; the entry records
+    # which announce variant the group adopted (live-ctrler mode).
+    cfg_val = _pack_config(node_cfg + 1, adopt_var[:, None])  # [G, N]
     log_term, log_val, log_len = append_at(
         ln_oh & can_advance[:, None] & is_lead, cfg_val,
         log_term, log_val, log_len,
@@ -1191,6 +1472,11 @@ def shardkv_step(
     return ShardKvState(
         rafts=rafts,
         cfg_tick=st.cfg_tick, cfg_owner=st.cfg_owner,
+        ctrl=ctrl, ctrl_w_frontier=ctrl_w_frontier,
+        ctrl_w_stalled=ctrl_w_stalled, win_var=win_var,
+        cq_req_t=cq_req_t, cq_req_node=cq_req_node, cq_req_j=cq_req_j,
+        cq_rsp_t=cq_rsp_t, cq_rsp_j=cq_rsp_j,
+        cq_rsp_found=cq_rsp_found, cq_rsp_var=cq_rsp_var,
         applied=applied, node_cfg=node_cfg, phase=phase,
         key_hash=key_hash, key_count=key_count, last_seq=last_seq,
         snap_cfg=snap_cfg, snap_phase=snap_phase,
@@ -1233,6 +1519,10 @@ class ShardKvFuzzReport(NamedTuple):
     owned_copies: np.ndarray          # per-deployment max owners of any shard
     frozen_left: np.ndarray           # frozen copies remaining at the end
     max_cfg_lag: np.ndarray           # max configs a restarting node missed
+    ann_resolved: np.ndarray          # live-ctrler: committed announce
+    #                                   frontier (0 when the mode is off)
+    ctrl_walker_stalled: np.ndarray   # live-ctrler: oracle coverage lost
+    #                                   (sticky; False when the mode is off)
 
     @property
     def n_violating(self) -> int:
@@ -1314,7 +1604,7 @@ def _validate_shardkv_knobs(skn) -> None:
     k = jax.tree.map(np.asarray, skn)
     validate_probs(
         k, ("p_op", "p_get", "p_put", "p_retry", "p_cfg_learn", "p_pull",
-            "p_ack", "pull_loss"), "shardkv",
+            "p_ack", "pull_loss", "p_announce", "p_phantom"), "shardkv",
     )
     if (k.p_get + k.p_put > 1.0).any():
         raise ValueError("p_get + p_put must stay <= 1 per deployment")
@@ -1327,7 +1617,8 @@ def _validate_shardkv_knobs(skn) -> None:
     if (k.cfg_interval < 2).any():
         raise ValueError(f"cfg_interval must be >= 2: {k.cfg_interval}")
     validate_bool_bugs(
-        k, ("bug_skip_freeze", "bug_drop_dup_table", "bug_serve_frozen"),
+        k, ("bug_skip_freeze", "bug_drop_dup_table", "bug_serve_frozen",
+            "bug_stale_ctrler_read"),
         "shardkv",
     )
 
@@ -1382,6 +1673,11 @@ def shardkv_report(final: ShardKvState) -> ShardKvFuzzReport:
         owned_copies=owned.max(axis=-1),
         frozen_left=frozen.sum(axis=-1),
         max_cfg_lag=np.asarray(final.max_cfg_lag),
+        ann_resolved=np.asarray(
+            np.cumprod(np.asarray(final.win_var) >= 0, axis=-1).sum(axis=-1)
+            - 1
+        ),
+        ctrl_walker_stalled=np.asarray(final.ctrl_w_stalled),
     )
 
 

@@ -297,6 +297,7 @@ def test_ctrler_bridge_exact_map_on_clean_run():
     rep = ctrler_fuzz(CTRL_SIM, kcfg, seed=11, n_clusters=8, n_ticks=n_ticks)
     assert rep.n_violating == 0
     checked = 0
+    multi_gid_ops = 0
     for cid in range(8):
         if rep.configs_created[cid] < 5:
             continue
@@ -304,6 +305,10 @@ def test_ctrler_bridge_exact_map_on_clean_run():
             CTRL_SIM, kcfg, 11, cid, n_ticks
         )
         assert sched.bug == "none" and sched.expect_cfgs >= 5
+        multi_gid_ops += sum(
+            1 for op in sched.ops
+            if op[0] in ("join", "leave") and len(op) > 2
+        )
         cpp = bridge.replay_ctrler_on_simcore(sched, binary=binary)
         assert cpp["map_match"] == 1, (sched.dumps(), cpp)
         assert cpp["balance_bad"] == 0 and cpp["minimal_bad"] == 0, cpp
@@ -312,6 +317,10 @@ def test_ctrler_bridge_exact_map_on_clean_run():
         if checked >= 3:
             break
     assert checked >= 2, "not enough config churn exported to prove parity"
+    assert multi_gid_ops > 0, (
+        "no multi-gid Join/Leave crossed the bridge — the C++ ShardInfo "
+        "never saw the map-of-groups op shape (msg.rs:20-37)"
+    )
 
 
 def test_ctrler_bridge_replays_bug_classes():

@@ -49,7 +49,8 @@ def test_kvconfig_fields_all_reach_the_program():
 
 
 def test_ctrlerconfig_fields_all_reach_the_program():
-    static = {"n_gids", "n_clients", "n_configs", "apply_max", "walk_max"}
+    static = {"n_gids", "n_clients", "n_configs", "join_max", "apply_max",
+              "walk_max"}
     knob_names = set(CtrlerKnobs._fields)
     for f in dataclasses.fields(CtrlerConfig):
         if f.name in static:
@@ -63,7 +64,7 @@ def test_shardkvconfig_fields_all_reach_the_program():
     from madraft_tpu.tpusim.shardkv import ShardKvConfig, ShardKvKnobs
 
     static = {"n_groups", "n_shards", "n_clients", "n_configs",
-              "apply_max", "walk_max"}
+              "apply_max", "walk_max", "live_ctrler"}
     knob_names = set(ShardKvKnobs._fields)
     for f in dataclasses.fields(ShardKvConfig):
         if f.name in static:

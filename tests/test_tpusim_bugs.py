@@ -30,34 +30,29 @@ from madraft_tpu.tpusim.config import (
     VIOLATION_LOG_MATCHING,
 )
 
-# Election/replication churn with client load, mirroring the figure_8_2c
-# storm (/root/reference/src/raft/tests.rs:612-660): leaders crash often,
-# the network repartitions, commits keep happening between faults.
-STORM = SimConfig(
-    n_nodes=5,
-    p_client_cmd=0.3,
-    p_crash=0.05,
-    p_restart=0.3,
-    max_dead=2,
-    p_repartition=0.03,
-    p_heal=0.05,
-    loss_prob=0.1,
-)
+# The tuned storms live in config.storm_profiles() — ONE source shared with
+# the CLI --profile presets, so the demonstrated (profile, bug, scale)
+# triples can never drift from what these tests validate. Profile shapes:
+# STORM mirrors the figure_8_2c churn (/root/reference/src/raft/
+# tests.rs:612-660); FIG8 is the slow-catch-up variant; REVOTE the
+# crash-while-voting 7-node storm (see module docstring).
+from madraft_tpu.tpusim.config import storm_profiles
 
-# Slow-catch-up storm for the Figure-8 commit bug (see module docstring).
-FIG8 = STORM.replace(
-    ae_max=1, delay_max=5, p_repartition=0.03, loss_prob=0.1, p_client_cmd=0.4,
-)
+_PROFILES = storm_profiles()
+STORM = _PROFILES["storm"][0]
+FIG8 = _PROFILES["fig8"][0]
+REVOTE = _PROFILES["revote"][0]
 
-# Crash-while-voting storm for the votedFor-persistence bug: 7 nodes give
-# five voters' worth of double-vote chances, short timeouts give ~2x the
-# elections, delay_max=6 widens each RequestVote's crash-restart window
-# (the rate is thin — a few per thousand clusters — because the revote must
-# land inside ONE RV flight while both same-term candidates stay live).
-REVOTE = STORM.replace(
-    n_nodes=7, max_dead=3, p_crash=0.15, p_restart=0.6, delay_max=6,
-    election_timeout_min=10, election_timeout_max=20, p_client_cmd=0.1,
-)
+
+def test_profiles_scale_matches_demonstrations():
+    """The CLI presets advertise exactly the (clusters, ticks) these tests
+    demonstrate each bug at — keep them honest."""
+    assert _PROFILES["fig8"][1:3] == (1024, 1000)
+    assert _PROFILES["revote"][1:3] == (2048, 1000)
+    assert _PROFILES["storm"][1:3] == (256, 600)
+    assert "commit_any_term" in _PROFILES["fig8"][3]
+    assert "forget_voted_for" in _PROFILES["revote"][3]
+    assert set(_PROFILES["storm"][3]) == {"grant_any_vote", "no_truncate"}
 
 
 def _bits(rep):

@@ -143,6 +143,40 @@ def test_rebalance_matches_numpy_model():
         )
 
 
+def test_rebalance_multigid_transition_chain_minimal():
+    """Chained multi-gid Join/Leave transitions (1..3 gids per op — the
+    reference's Join takes a MAP of groups and its suite fuzzes concurrent
+    multijoins, msg.rs:20-37, tests.rs:216-237): every step must stay
+    balanced, orphan-free, minimal-move, and equal to the numpy model."""
+    rng = np.random.default_rng(9)
+    off = jnp.bool_(False)
+    member = np.zeros(NG, bool)
+    owner = np.full(N_SHARDS, -1, np.int64)
+    multi = 0
+    for _ in range(200):
+        mask = np.zeros(NG, bool)
+        picks = rng.choice(NG, size=int(rng.integers(1, 4)), replace=False)
+        mask[picks] = True
+        new_member = (member | mask) if rng.random() < 0.55 else (member & ~mask)
+        if not new_member.any() or (new_member == member).all():
+            continue
+        multi += int(np.sum(new_member != member) >= 2)
+        got = np.asarray(
+            _rebalance(NG, jnp.asarray(new_member), jnp.asarray(owner, I32),
+                       jnp.asarray(0, I32), off, off)
+        )
+        want = np.asarray(ref_rebalance(new_member.tolist(), owner.tolist()))
+        np.testing.assert_array_equal(got, want)
+        counts = [int((got == g).sum()) for g in range(NG) if new_member[g]]
+        assert all(new_member[g] for g in got)
+        assert max(counts) - min(counts) <= 1
+        assert int((got != owner).sum()) == ref_min_moves(
+            new_member.tolist(), owner.tolist()
+        )
+        member, owner = new_member, got.astype(np.int64)
+    assert multi > 40, "the chain barely exercised multi-gid transitions"
+
+
 def test_rebalance_tie_rotation_permutes_but_stays_balanced():
     """Rotated tie-breaking (the planted divergence bug) must still produce a
     balanced minimal assignment — only a DIFFERENT one, so the divergence
@@ -175,6 +209,41 @@ def test_ctrler_fuzz_clean():
     assert (rep.acked_ops > 0).mean() > 0.9
     assert rep.configs_created.sum() > 96 * 3, "reconfigurations must flow"
     assert rep.queries_done.sum() > 96, "historical queries must complete"
+    assert not rep.walker_stalled.any(), (
+        "truth walker fell behind the shadow window: 4A oracle coverage lost"
+    )
+
+
+def test_ctrler_walker_stall_is_sticky_and_reported():
+    """A walker whose next entry has been overwritten by shadow-ring
+    wraparound must raise the sticky stalled flag instead of silently
+    standing the oracles down — a clean report with a frozen frontier is
+    indistinguishable from real coverage (round-3 advisor finding).
+
+    A live fuzz cannot reach this state (measured commit throughput is
+    ~0.5 entries/tick, below any walk budget), so the window-slid state is
+    constructed directly: shadow_base past the frontier, exactly the
+    configuration a commit burst > log_cap would leave behind."""
+    from madraft_tpu.tpusim.ctrler import ctrler_step, init_ctrler_cluster
+
+    cfg = BASE.replace(loss_prob=0.0, p_crash=0.0, p_repartition=0.0)
+    cap = cfg.log_cap
+    key = jax.random.PRNGKey(3)
+    ks = init_ctrler_cluster(cfg, CT, key)
+    behind = ks._replace(
+        raft=ks.raft._replace(
+            shadow_len=jnp.asarray(cap + 5, I32),
+            shadow_base=jnp.asarray(5, I32),
+        )
+    )
+    out = jax.jit(
+        lambda s, k: ctrler_step(cfg, CT, s, k)
+    )(behind, key)
+    assert bool(out.w_stalled), "slid-window walker must report the stall"
+    # and it is sticky: a later tick with the same frontier keeps it set
+    out2 = jax.jit(lambda s, k: ctrler_step(cfg, CT, s, k))(out, key)
+    assert bool(out2.w_stalled)
+    # a healthy run never sets it (covered in test_ctrler_fuzz_clean)
 
 
 def test_ctrler_rotate_tiebreak_diverges():

@@ -47,7 +47,7 @@ def test_shardkv_op_pack_roundtrip():
                     v = int(skvm._pack_op(cfg, client, seq, shard, kind))
                     assert v != 0 and v not in seen
                     seen.add(v)
-                    kd, c, s, sh, _, _ = skvm._unpack(cfg, np.int32(v))
+                    kd, c, s, sh, _, _, _ = skvm._unpack(cfg, np.int32(v))
                     assert (int(kd), int(c), int(s), int(sh)) == (
                         kind, client, seq, shard
                     )
@@ -59,16 +59,18 @@ def test_shardkv_marker_packs_roundtrip_disjoint():
     cfg = skvm.ShardKvConfig()
     seen = set()
     for c in range(cfg.n_configs):
-        v = int(skvm._pack_config(np.int32(c)))
-        kd, _, _, _, cfg_c, _ = skvm._unpack(cfg, np.int32(v))
-        assert int(kd) == skvm._CONFIG and int(cfg_c) == c
-        assert v not in seen
-        seen.add(v)
+        for var in (0, 1):  # the adopted-announce variant bit (live ctrler)
+            v = int(skvm._pack_config(np.int32(c), var))
+            kd, _, _, _, cfg_c, _, var_c = skvm._unpack(cfg, np.int32(v))
+            assert int(kd) == skvm._CONFIG and int(cfg_c) == c
+            assert int(var_c) == var
+            assert v not in seen
+            seen.add(v)
         for shard in range(cfg.n_shards):
             vi = int(skvm._pack_install(cfg, np.int32(c), np.int32(shard)))
             vd = int(skvm._pack_delete(cfg, np.int32(c), np.int32(shard)))
             for v2, want_kind in ((vi, skvm._INSTALL), (vd, skvm._DELETE)):
-                kd, _, _, sh, _, cfg_i = skvm._unpack(cfg, np.int32(v2))
+                kd, _, _, sh, _, cfg_i, _ = skvm._unpack(cfg, np.int32(v2))
                 assert int(kd) == want_kind
                 assert int(sh) == shard and int(cfg_i) == c
                 assert v2 not in seen

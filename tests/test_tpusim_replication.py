@@ -91,6 +91,107 @@ def test_liveness_across_delay_spans():
         )
 
 
+def test_backup_convergence_budget_across_delay_spans():
+    """Batched backup_2b analogue (/root/reference/src/raft/tests.rs:316-388):
+    cut {leader, one partner} away from the majority; the stale leader
+    piles up ~30 uncommitted client entries (flow_cap deep) while the
+    majority commits its own; heal; convergence must land within a tick
+    budget AND a message budget, swept over delay spans {1..5} in ONE
+    program (delay knobs are per-cluster). This pins the round-3
+    keep-oldest/response-starvation fixes: a regression shows up as a
+    starved span blowing the tick budget or a retry storm blowing the
+    message budget (tests.rs:461-476's RPC-budget idea applied to
+    recovery).
+
+    Budgets from calibration: every span with dmax<5 converged well inside
+    128 ticks (max msgs/cluster seen 613 at span 1..1); the fully
+    deterministic 5..5 span has a long symmetric-election tail (one seed
+    needed 256 ticks of repeated vote splits before randomized timeouts
+    broke the tie) — its budget is 320 ticks.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from madraft_tpu.tpusim import init_cluster, step_cluster
+    from madraft_tpu.tpusim.config import LEADER
+
+    spans = ((1, 1), (1, 3), (2, 3), (3, 5), (5, 5))
+    per = 8
+    nc = per * len(spans)
+    cfg = SimConfig(n_nodes=5, p_client_cmd=0.3)
+    kn = cfg.knobs().broadcast(nc)
+    kn = kn._replace(
+        delay_min=jnp.repeat(
+            jnp.asarray([s[0] for s in spans], jnp.int32), per
+        ),
+        delay_max=jnp.repeat(
+            jnp.asarray([s[1] for s in spans], jnp.int32), per
+        ),
+    )
+    keys = jax.vmap(
+        lambda i: jax.random.fold_in(jax.random.PRNGKey(17), i)
+    )(jnp.arange(nc))
+    states = jax.vmap(functools.partial(init_cluster, cfg))(keys)
+
+    def make_phase(ticks):
+        @jax.jit
+        def run(states):
+            def body(c, _):
+                return (
+                    jax.vmap(functools.partial(step_cluster, cfg))(
+                        c, keys, kn
+                    ),
+                    None,
+                )
+
+            out, _ = jax.lax.scan(body, states, None, length=ticks)
+            return out
+
+        return run
+
+    s1 = make_phase(60)(states)
+    lead = np.asarray(jnp.argmax((s1.role == LEADER) & s1.alive, axis=1))
+    part = (lead + 1) % 5
+    side = np.zeros((nc, 5), bool)
+    side[np.arange(nc), lead] = True
+    side[np.arange(nc), part] = True
+    adj = jnp.asarray(side[:, :, None] == side[:, None, :])
+    s2 = make_phase(100)(s1._replace(adj=adj))
+    # the scenario has teeth: stale leaders accumulated divergent tails
+    tail = np.asarray(s2.log_len)[np.arange(nc), lead] - np.asarray(
+        s2.commit
+    )[np.arange(nc), lead]
+    assert tail.mean() > 5, f"no divergence built up: {tail.tolist()}"
+
+    sh0 = np.asarray(s2.shadow_len)
+    mc0 = np.asarray(s2.msg_count)
+    healed = s2._replace(adj=jnp.ones_like(adj))
+    s3 = make_phase(128)(healed)
+    assert (np.asarray(s3.violations) == 0).all(), "safety broke on heal"
+    new = np.asarray(s3.shadow_len) - sh0
+    msgs = np.asarray(s3.msg_count) - mc0
+    fast = np.arange(nc) < 4 * per  # every span except 5..5
+    assert (new[fast] >= 3).all(), (
+        f"a dmax<5 span failed the 128-tick convergence budget: "
+        f"{new[:4 * per].tolist()}"
+    )
+    assert (msgs <= 1200).all(), (
+        f"message budget blown (retry storm): {msgs.max()}"
+    )
+    # the deterministic 5..5 span gets its calibrated longer budget
+    s4 = make_phase(192)(s3)
+    assert (np.asarray(s4.violations) == 0).all()
+    new4 = np.asarray(s4.shadow_len) - sh0
+    msgs4 = np.asarray(s4.msg_count) - mc0
+    assert (new4 >= 3).all(), (
+        f"the 5..5 span failed the 320-tick convergence budget: "
+        f"{new4[4 * per:].tolist()}"
+    )
+    assert (msgs4 <= 2400).all(), f"message budget blown: {msgs4.max()}"
+
+
 def test_heterogeneous_fault_sweep():
     # make_sweep_fn: one compiled program fuzzes a GRID of fault intensities
     # across the cluster batch (the TPU-idiomatic inversion of the

@@ -116,6 +116,57 @@ def test_shardkv_fault_storm():
     assert (rep.acked_ops > 0).all()
 
 
+def test_shardkv_live_ctrler_clean():
+    """Configs come from an ON-DEVICE replicated controller raft cluster
+    (the reference's servers poll the ctrler via a ctrl-plane clerk,
+    shardkv/server.rs:12-18): the ANNOUNCE stream rides the ctrler's raft
+    under a fault storm, groups learn configs via racing Query reads over
+    lossy mailboxes, and the truth-vs-phantom announce race resolves by
+    commit order. All existing oracles plus CTRL_STALE must stay green,
+    announces must resolve, and migrations must still chain through."""
+    storm = RAFT.replace(
+        p_crash=0.01, p_restart=0.2, max_dead=1, loss_prob=0.1,
+        p_repartition=0.03, p_heal=0.08,
+    )
+    kcfg = SKV.replace(live_ctrler=True, p_phantom=0.4, cfg_interval=40)
+    rep = shardkv_fuzz(storm, kcfg, seed=3, n_clusters=24, n_ticks=TICKS)
+    assert rep.n_violating == 0, (
+        f"violations {rep.violations[rep.violating_clusters()[:8]]} raft "
+        f"{rep.raft_violations[rep.violating_clusters()[:8]]}"
+    )
+    assert (rep.ann_resolved >= 2).mean() > 0.8, (
+        f"the live controller barely committed announces: {rep.ann_resolved}"
+    )
+    assert rep.installs.sum() > 24, "migrations must flow from live configs"
+    assert (rep.final_cfg >= 1).mean() > 0.8, (
+        f"groups barely adopted live configs: {rep.final_cfg}"
+    )
+
+
+def test_shardkv_live_ctrler_stale_read_bug_caught():
+    """bug_stale_ctrler_read: a queried ctrler node answers from its raw log
+    tail, where a phantom announce (the losing order of racing proposals)
+    may sit until raft rolls it back — a group can adopt a config the
+    controller never committed. The CTRL_STALE oracle must flag it; the
+    same storm without the bug is covered clean above."""
+    from madraft_tpu.tpusim.shardkv import VIOLATION_SHARD_CTRL_STALE
+
+    storm = RAFT.replace(
+        p_crash=0.02, p_restart=0.2, max_dead=1, loss_prob=0.15,
+        p_repartition=0.05, p_heal=0.08,
+    )
+    kcfg = SKV.replace(
+        live_ctrler=True, bug_stale_ctrler_read=True, p_phantom=0.5,
+        cfg_interval=40,
+    )
+    rep = shardkv_fuzz(storm, kcfg, seed=5, n_clusters=32, n_ticks=512)
+    stale = (rep.violations & VIOLATION_SHARD_CTRL_STALE) != 0
+    assert stale.any(), (
+        "no deployment adopted a never-committed config — the planted "
+        "stale-ctrler-read bug never manifested or the oracle is inert"
+    )
+
+
 def test_shardkv_missed_configs_catch_up():
     """miss_change_4b: nodes sleep through SEVERAL config activations (slow
     restarts, fast config churn) and catch up by log replay / snapshot
