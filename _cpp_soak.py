@@ -56,11 +56,24 @@ def main() -> None:
         # failures exit nonzero with a FAIL/hash-mismatch line
         oks = len(re.findall(r"^\[ OK", proc.stdout, re.M))
         bad = re.findall(
-            r"^.*(?:FAIL|mismatch|panic).*$", proc.stdout + proc.stderr, re.M
+            r"^.*(?:FAIL|mismatch|panic|WDOG).*$", proc.stdout + proc.stderr,
+            re.M,
         )
         if proc.returncode != 0 or bad:
             tail = (bad or proc.stdout.strip().splitlines()[-1:])[:3]
-            failed.append({"seed": seed, "rc": proc.returncode, "tail": tail})
+            row = {"seed": seed, "rc": proc.returncode, "tail": tail}
+            # the in-sim watchdog names the wedged test and its virtual time
+            # (so a hang is a localized finding, not an empty-tail mystery)
+            m = re.search(
+                r"\[WDOG \] test (\S+) exceeded .*?"
+                r"\(real ([0-9.]+)s, virtual ([0-9.]+)s\)",
+                proc.stderr,
+            )
+            if m:
+                row["test"] = m.group(1)
+                row["real_time_s"] = float(m.group(2))
+                row["virt_time_s"] = float(m.group(3))
+            failed.append(row)
             print(json.dumps(failed[-1]), flush=True)
         else:
             tests_per_seed = max(tests_per_seed, oks // 2)

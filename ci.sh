@@ -10,16 +10,29 @@
 #   4. Python/TPU-sim suite on the 8-device virtual CPU mesh
 #   5. Bench smoke (small cluster batch; CPU unless a TPU is attached)
 #
-# Usage: ./ci.sh [--fast]   (--fast skips ASan and the second seed)
+# Usage: ./ci.sh [--fast]        (--fast skips ASan and the second seed)
+#        ./ci.sh --soak [N]      (nightly: N-seed C++ suite soak via
+#                                 _cpp_soak.py, default 500, then exit)
 set -euo pipefail
 cd "$(dirname "$0")"
 FAST=${1:-}
 
-echo "== [1/5] C++ Release build + tests (seed 12345, 2 seeds)"
+if [ "$FAST" = "--soak" ]; then
+  N=${2:-500}
+  cmake -S cpp -B build -G Ninja -DCMAKE_BUILD_TYPE=Release >/dev/null
+  ninja -C build >/dev/null
+  SOAK_OUT=${SOAK_OUT:-SOAK_cpp_nightly.json} python _cpp_soak.py "$N"
+  exit $?
+fi
+
+echo "== [1/5] C++ Release build + tests (seed 12345, 2 seeds + regression seed 7036)"
 cmake -S cpp -B build -G Ninja -DCMAKE_BUILD_TYPE=Release >/dev/null
 ninja -C build >/dev/null
 MADTPU_TEST_SEED=12345 MADTPU_TEST_NUM=$([ "$FAST" = "--fast" ] && echo 1 || echo 2) \
   ./build/madtpu_tests | tail -1
+# seed 7036: the round-4 soak's deterministic shardkv liveness hang (PERF.md
+# round 5 — config starvation via the linearizable clerk path); keep it green
+MADTPU_TEST_SEED=7036 ./build/madtpu_tests shardkv_challenge2_unaffected_4b | tail -1
 
 echo "== [2/5] C++ determinism double-run"
 MADTPU_TEST_SEED=424242 MADTPU_TEST_CHECK_DETERMINISTIC=1 \

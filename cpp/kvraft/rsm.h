@@ -279,6 +279,7 @@ class ClerkCore {
   }
 
   uint64_t id() const { return id_; }
+  const std::vector<Addr>& servers() const { return servers_; }
 
  private:
   Sim* sim_;

@@ -344,7 +344,66 @@ struct ShardInfo {
   }
 };
 
-using ShardCtrler = RsmServer<ShardInfo>;
+// Raft-free config fan-out read (no reference analogue; the reference's
+// server.rs:12-14 poll loop rides the linearizable clerk). A shardkv group
+// learns "config num N exists" by asking ANY ctrler replica for exactly num
+// N out of its applied state. No raft commit, no clerk seq, no dup-table
+// entry: the op is idempotent and a stale replica simply answers ok=false,
+// so staleness delays learning but can never corrupt it (the group adopts
+// configs strictly in num order regardless of who answered). This is what
+// keeps the 4B config pipeline's latency ~1 RTT instead of riding ctrler
+// leader churn — seed 7036 (PERF.md) showed the clerk path taking >2 virtual
+// seconds per query under loss, starving a group of a config until the test
+// killed it mid-migration.
+struct ConfigRead {
+  uint64_t num = 0;
+  struct Reply {
+    bool ok = false;
+    raftcore::Bytes data;  // encoded Config, valid iff ok
+    Reply() = default;     // non-aggregate (gcc-12 coroutine relocation)
+  };
+  ConfigRead() = default;
+  explicit ConfigRead(uint64_t n) : num(n) {}
+};
+
+class ShardCtrler : public RsmServer<ShardInfo> {
+ public:
+  static Task<std::shared_ptr<ShardCtrler>> boot(
+      Sim* sim, std::vector<Addr> servers, size_t me,
+      std::optional<size_t> max_raft_state) {
+    auto self = std::shared_ptr<ShardCtrler>(
+        new ShardCtrler(sim, servers, me, max_raft_state));
+    self->raft_ = co_await sim->spawn(
+        raftcore::Raft::boot(sim, servers, me, self->apply_ch_));
+    sim->add_rpc_handler<kvraft::RsmRequest<ShardInfo>>(
+        [self](kvraft::RsmRequest<ShardInfo> req) {
+          return handle(self, std::move(req));
+        });
+    sim->add_rpc_handler<ConfigRead>([self](ConfigRead a) {
+      return handle_read(self, a);
+    });
+    sim->spawn(applier(self));
+    co_return self;
+  }
+
+ private:
+  ShardCtrler(Sim* sim, std::vector<Addr> servers, size_t me,
+              std::optional<size_t> mrs)
+      : RsmServer<ShardInfo>(sim, std::move(servers), me, mrs) {}
+
+  static Task<ConfigRead::Reply> handle_read(std::shared_ptr<ShardCtrler> self,
+                                             ConfigRead a) {
+    ConfigRead::Reply rep;
+    const auto& configs = self->state().configs;
+    if (a.num < configs.size()) {
+      Enc e;
+      Config::enc(e, configs[a.num]);
+      rep.ok = true;
+      rep.data = std::move(e.out);
+    }
+    co_return rep;
+  }
+};
 
 // client.rs:9-35 — the clerk reuses the generic retrying core
 class CtrlerClerk {
@@ -373,6 +432,7 @@ class CtrlerClerk {
     return applied(core_.call(CtrlOp::move_(shard, gid)));
   }
   uint64_t id() const { return core_.id(); }
+  const std::vector<Addr>& servers() const { return core_.servers(); }
 
  private:
   static Task<Config> unwrap(Task<std::optional<Config>> t) {

@@ -346,20 +346,37 @@ class ShardKvServer : public std::enable_shared_from_this<ShardKvServer> {
   }
 
   // ---- config poller: fetch config num+1 when the current migration is done
-  // (server.rs:12-14 — the ctor-provided ctrl clerk exists for this loop)
+  // (server.rs:12-14 — the reference hands the server a ctrler clerk for this
+  // loop). NOT via the linearizable clerk: each clerk query commits a raft
+  // entry in the ctrler cluster and retries with 500 ms timeouts, so under
+  // loss + ctrler leader churn a single query can block for virtual SECONDS
+  // (seed 7036, PERF.md: group 100 starved of config 2 until the test killed
+  // it mid-migration, wedging its successor's pulls forever). The poller only
+  // needs "does config num+1 exist, and what is it" — an idempotent exact-num
+  // read — so it asks each ctrler replica directly via the raft-free
+  // ConfigRead fan-out; any replica that has applied the config answers.
   static Task<void> config_poller(std::shared_ptr<ShardKvServer> self) {
     for (;;) {
       co_await self->sim_->sleep(50 * MSEC);
       if (!self->raft_->is_leader()) continue;
       if (!self->pull_pending_.empty()) continue;  // finish migration first
       uint64_t want = self->config_.num + 1;
-      Config c = co_await self->ctrl_ck_->query_at(want);
-      if (c.num != want) continue;  // no newer config yet
+      std::optional<Config> found;
+      for (Addr a : self->ctrl_ck_->servers()) {
+        auto rep = co_await self->sim_->call_timeout(
+            a, shard_ctrler::ConfigRead{want}, 100 * MSEC);
+        if (rep && rep->ok) {
+          Dec d(rep->data);
+          found = Config::dec(d);
+          break;
+        }
+      }
+      if (!found || found->num != want) continue;  // no newer config yet
       if (self->config_.num + 1 != want || !self->pull_pending_.empty())
-        continue;  // state moved while we awaited the query
+        continue;  // state moved while we awaited the reads
       Enc e;
       e.u64(uint64_t(Cmd::Config));
-      Config::enc(e, c);
+      Config::enc(e, *found);
       self->raft_->start(std::move(e.out));
     }
   }

@@ -1,4 +1,5 @@
 #include "simcore.h"
+#include <chrono>
 #include <cstdarg>
 
 namespace simcore {
@@ -114,9 +115,35 @@ void Sim::SleepAwaiter::await_suspend(std::coroutine_handle<> h) {
 
 bool Sim::run(Task<void> main) {
   g_current = this;
+  const auto& wd = watchdog();
+  const auto wd_real0 = std::chrono::steady_clock::now();
+  const uint64_t wd_virt0 = now_;
+  uint64_t wd_countdown = 0;
   auto ref = spawn(Addr(0), std::move(main));
   while (!ref.done()) {
     if (events_.empty()) return false;  // deadlock
+    if (wd.enabled && wd_countdown-- == 0) {
+      wd_countdown = 8192;  // amortize the clock read
+      double real = std::chrono::duration<double>(
+                        std::chrono::steady_clock::now() - wd_real0)
+                        .count();
+      double virt = (now_ - wd_virt0) / 1e9;
+      const char* name = wd.name_fn ? wd.name_fn() : "?";
+      if (wd.real_cap_s > 0 && real > wd.real_cap_s) {
+        std::fprintf(stderr,
+                     "[WDOG ] test %s exceeded %.0fs real time — liveness "
+                     "failure (real %.2fs, virtual %.2fs)\n",
+                     name, wd.real_cap_s, real, virt);
+        std::abort();
+      }
+      if (wd.virt_cap_s > 0 && virt > wd.virt_cap_s) {
+        std::fprintf(stderr,
+                     "[WDOG ] test %s exceeded %.0fs VIRTUAL time — livelock "
+                     "burning virtual time (real %.2fs, virtual %.2fs)\n",
+                     name, wd.virt_cap_s, real, virt);
+        std::abort();
+      }
+    }
     Event ev = std::move(const_cast<Event&>(events_.top()));
     events_.pop();
     now_ = ev.t;

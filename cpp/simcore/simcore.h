@@ -368,6 +368,24 @@ class Sim {
   // ---- run loop: drives events until `main` completes. Returns false on
   // deadlock (no runnable events while main is still pending).
   bool run(Task<void> main);
+
+  // Per-run liveness watchdog, enabled by the test runner (main.cpp) and off
+  // by default so the replay tools can run unbounded schedules. Mirrors the
+  // reference's 120 s per-test panic (/root/reference/src/raft/tester.rs:
+  // 353-358, kvraft/tester.rs:62-67, shardkv/tester.rs:226-231) and adds a
+  // virtual-time cap so a livelock that burns virtual time (retry loops with
+  // sleeps — the seed-7036 shape) is distinguishable from a real-time-slow
+  // test: the abort names the test and both clocks.
+  struct Watchdog {
+    bool enabled = false;
+    double real_cap_s = 120.0;  // reference parity
+    double virt_cap_s = 600.0;  // ~10x the slowest legit test (61 s virt)
+    const char* (*name_fn)() = nullptr;  // current test name for the abort
+  };
+  static Watchdog& watchdog() {
+    static Watchdog w;
+    return w;
+  }
   uint64_t trace_hash() const { return trace_hash_; }
   // Observer invoked with the final trace hash at the end of each run();
   // the test runner uses it for the double-run determinism check

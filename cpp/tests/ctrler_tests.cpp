@@ -278,3 +278,56 @@ MT_TEST(ctrler_multi_4a) {
   Sim sim(seed);
   MT_ASSERT(sim.run(multi_main(&sim)));
 }
+
+// ---- config_read_4a: the raft-free ConfigRead fan-out (seed-7036 regression,
+// PERF.md round 5). The 4B config poller learns configs through this path, so
+// it must (a) be answered replica-locally by ANY server — including followers
+// and a minority partition's members — for a config that replica has applied,
+// (b) cost exactly one request + one reply, never a raft commit, and (c)
+// answer ok=false (not a stale config) for a num the replica hasn't applied.
+namespace {
+Task<void> config_read_main(Sim* sim) {
+  CtrlerTester t(sim, 3, false);
+  co_await sim->spawn(t.init());
+  auto ck = t.make_client();
+  co_await sim->spawn(ck.join(grp(1, srvs(1, 2, 3))));
+  co_await sim->sleep(1 * SEC);  // let followers apply config 1
+
+  Addr probe = make_addr(0, 0, 9, 9);
+  for (int i = 0; i < 3; i++) {
+    Addr a = make_addr(0, 0, 1, i + 1);
+    auto rep = co_await sim->spawn(
+        probe, [](Sim* s, Addr dst) -> Task<std::optional<ConfigRead::Reply>> {
+          co_return co_await s->call_timeout(dst, ConfigRead{1}, 500 * MSEC);
+        }(sim, a));
+    MT_ASSERT(rep.has_value() && rep->ok);
+    raftcore::Dec d(rep->data);
+    Config c = Config::dec(d);
+    MT_ASSERT_EQ(c.num, 1u);
+    MT_ASSERT(c.groups.count(1));
+
+    auto future = co_await sim->spawn(
+        probe, [](Sim* s, Addr dst) -> Task<std::optional<ConfigRead::Reply>> {
+          co_return co_await s->call_timeout(dst, ConfigRead{7}, 500 * MSEC);
+        }(sim, a));
+    MT_ASSERT(future.has_value() && !future->ok);  // unapplied num: miss
+  }
+
+  // Replica-locality proof: with the majority dead no consensus op can
+  // commit, yet the survivor still answers ConfigRead from applied state —
+  // exactly what keeps a 4B group learning configs through ctrler churn.
+  t.shutdown_server(1);
+  t.shutdown_server(2);
+  auto lone = co_await sim->spawn(
+      probe, [](Sim* s, Addr dst) -> Task<std::optional<ConfigRead::Reply>> {
+        co_return co_await s->call_timeout(dst, ConfigRead{1}, 500 * MSEC);
+      }(sim, make_addr(0, 0, 1, 1)));
+  MT_ASSERT(lone.has_value() && lone->ok);
+  t.end();
+}
+}  // namespace
+
+MT_TEST(ctrler_config_read_4a) {
+  Sim sim(seed);
+  MT_ASSERT(sim.run(config_read_main(&sim)));
+}

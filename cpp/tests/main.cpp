@@ -13,11 +13,13 @@
 
 namespace {
 uint64_t g_hash_acc = 0;
+const char* g_current_test = "?";
 
 void run_once(const mtest::TestCase& t, uint64_t s) {
   std::printf("[ RUN  ] %s  MADTPU_TEST_SEED=%llu\n", t.name,
               (unsigned long long)s);
   std::fflush(stdout);
+  g_current_test = t.name;
   t.fn(s);
   std::printf("[ OK   ] %s\n", t.name);
   std::fflush(stdout);
@@ -38,6 +40,16 @@ int main(int argc, char** argv) {
     seed = (uint64_t)std::chrono::steady_clock::now().time_since_epoch().count();
   int reruns = 1;
   if (const char* n = std::getenv("MADTPU_TEST_NUM")) reruns = std::atoi(n);
+  // Per-test liveness watchdog (reference tester.rs:353-358 — 120 s panic),
+  // plus a virtual-time cap for livelocks that keep virtual time moving.
+  // MADTPU_TEST_REAL_CAP / MADTPU_TEST_VIRT_CAP (seconds, 0 disables) tune it.
+  auto& wd = simcore::Sim::watchdog();
+  wd.enabled = true;
+  wd.name_fn = [] { return g_current_test; };
+  if (const char* c = std::getenv("MADTPU_TEST_REAL_CAP"))
+    wd.real_cap_s = std::atof(c);
+  if (const char* c = std::getenv("MADTPU_TEST_VIRT_CAP"))
+    wd.virt_cap_s = std::atof(c);
   const char* det_env = std::getenv("MADTPU_TEST_CHECK_DETERMINISTIC");
   bool check_det = det_env && det_env[0] && det_env[0] != '0';
   if (check_det)
@@ -48,7 +60,9 @@ int main(int argc, char** argv) {
 
   int ran = 0;
   for (auto& t : tests) {
-    bool selected = argc <= 1;
+    // wdog_selftest_* deliberately wedge to prove the watchdog fires; they
+    // run only when named explicitly (tests/test_cpp_suite.py does).
+    bool selected = argc <= 1 && std::strncmp(t.name, "wdog_selftest", 13) != 0;
     for (int i = 1; i < argc; i++)
       if (std::strcmp(argv[i], t.name) == 0) selected = true;
     if (!selected) continue;

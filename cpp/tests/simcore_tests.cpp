@@ -241,3 +241,16 @@ MT_TEST(sim_determinism) {
   MT_ASSERT_EQ(m1, m2);
   MT_ASSERT(h1 != h3);
 }
+
+// ---- watchdog self-test: NOT in the default suite (main.cpp skips
+// "wdog_selftest_*" unless named explicitly). A clerk-shaped retry loop that
+// burns virtual time forever — the seed-7036 hang shape. Run it with a small
+// MADTPU_TEST_VIRT_CAP and the watchdog must abort naming this test and both
+// clocks; tests/test_cpp_suite.py asserts exactly that.
+MT_TEST(wdog_selftest_wedge) {
+  Sim sim(seed);
+  auto body = [](Sim* s) -> Task<void> {
+    for (;;) co_await s->sleep(100 * MSEC);  // virtual progress, no real work
+  };
+  MT_ASSERT(sim.run(body(&sim)));
+}

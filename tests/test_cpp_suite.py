@@ -36,7 +36,9 @@ def _list_tests():
     out = subprocess.run(
         [str(BINARY), "--list"], check=True, capture_output=True, text=True
     )
-    return out.stdout.split()
+    # wdog_selftest_* deliberately wedge (they exist to prove the watchdog
+    # fires); test_watchdog_names_the_wedged_test drives them explicitly
+    return [t for t in out.stdout.split() if not t.startswith("wdog_selftest")]
 
 
 def pytest_generate_tests(metafunc):
@@ -55,3 +57,22 @@ def test_cpp(cpp_test_name):
         pytest.fail(
             f"{cpp_test_name} failed (seed {SEED}):\n{proc.stdout[-3000:]}\n{proc.stderr[-3000:]}"
         )
+
+
+def test_watchdog_names_the_wedged_test():
+    """The per-run liveness watchdog (reference tester.rs:353-358's 120s
+    panic + a virtual-time cap) must convert a wedged test into a crisp
+    failure naming the test and both clocks — not an opaque runner timeout
+    (the seed-7036 lesson, PERF.md round 5)."""
+    _ensure_built()
+    proc = subprocess.run(
+        [str(BINARY), "wdog_selftest_wedge"],
+        env={
+            "MADTPU_TEST_SEED": SEED,
+            "MADTPU_TEST_VIRT_CAP": "2",
+            "PATH": "/usr/bin:/bin",
+        },
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode != 0
+    assert "[WDOG ] test wdog_selftest_wedge exceeded 2s VIRTUAL" in proc.stderr
