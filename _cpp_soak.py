@@ -73,6 +73,12 @@ def main() -> None:
                 row["test"] = m.group(1)
                 row["real_time_s"] = float(m.group(2))
                 row["virt_time_s"] = float(m.group(3))
+            else:
+                # SIGALRM backstop (CPU-bound hang): test name only
+                m2 = re.search(r"\[WDOG \] test (\S+) hit the SIGALRM",
+                               proc.stderr)
+                if m2:
+                    row["test"] = m2.group(1)
             failed.append(row)
             print(json.dumps(failed[-1]), flush=True)
         else:

@@ -255,6 +255,18 @@ def main() -> None:
         1e8 * SCALE, skv_stats, seed0=5500,
     )
 
+    # --- shardkv with the COMPUTED controller (4A∘4B): ~1e8 steps ---------
+    # config content computed by the per-replica 4A rebalance from committed
+    # membership flips; the composite adopted-vs-canonical oracle is armed
+    ckcfg = ShardKvConfig(p_put=0.2, computed_ctrler=True, p_phantom=0.4,
+                          cfg_interval=40)
+    fn = make_shardkv_fuzz_fn(scfg, ckcfg, ncs, nts)
+    run_region(
+        "shardkv_computed_ctrler", fn,
+        ncs * nts * (ckcfg.n_groups + 1),
+        1e8 * SCALE, skv_stats, seed0=5800,
+    )
+
     total = sum(r["cluster_steps"] for r in rows)
     viol = sum(r["violating_clusters"] for r in rows)
     out = {

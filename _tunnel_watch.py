@@ -1,0 +1,38 @@
+"""Patient TPU-tunnel watcher.
+
+Probes the default (axon) backend in a subprocess on a fixed cadence until
+it answers, recording every outcome to TUNNEL_STATUS.jsonl via
+_platform._record_probe, then touches /tmp/madtpu_tunnel_up and exits.
+Never kills an in-flight TPU init (the verify-skill gotcha: killing TPU
+processes mid-init wedges the tunnel further) — each probe is its own
+subprocess with a hard timeout, and the waiter itself just sleeps.
+
+Usage: nohup python _tunnel_watch.py > /tmp/tunnel_watch.log 2>&1 &
+"""
+
+import sys
+import time
+
+from madraft_tpu import _platform
+
+MARKER = "/tmp/madtpu_tunnel_up"
+PERIOD_S = 600
+PROBE_TIMEOUT_S = 120
+
+
+def main() -> None:
+    n = 0
+    while True:
+        n += 1
+        ok, detail = _platform.probe_backend(None, timeout_s=PROBE_TIMEOUT_S)
+        print(f"probe {n}: ok={ok} {detail}", flush=True)
+        if ok:
+            with open(MARKER, "w") as f:
+                f.write(detail + "\n")
+            print("tunnel is up — marker written; exiting", flush=True)
+            return
+        time.sleep(PERIOD_S)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

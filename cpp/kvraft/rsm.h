@@ -129,8 +129,18 @@ class RsmServer : public std::enable_shared_from_this<RsmServer<S>> {
                                                std::vector<Addr> servers,
                                                size_t me,
                                                std::optional<size_t> max_raft_state) {
-    auto self = std::shared_ptr<RsmServer>(
-        new RsmServer(sim, servers, me, max_raft_state));
+    return boot_as<RsmServer>(sim, std::move(servers), me, max_raft_state);
+  }
+
+  // Boot a subclass (must add no state; e.g. ShardCtrler registers one extra
+  // RPC handler on top) through the SAME boot path — one implementation of
+  // the raft-boot + handler + applier sequence, so it cannot diverge.
+  template <class Derived>
+  static Task<std::shared_ptr<Derived>> boot_as(
+      Sim* sim, std::vector<Addr> servers, size_t me,
+      std::optional<size_t> max_raft_state) {
+    auto self =
+        std::shared_ptr<Derived>(new Derived(sim, servers, me, max_raft_state));
     self->raft_ =
         co_await sim->spawn(Raft::boot(sim, servers, me, self->apply_ch_));
     sim->add_rpc_handler<RsmRequest<S>>([self](RsmRequest<S> req) {

@@ -371,22 +371,16 @@ class ShardCtrler : public RsmServer<ShardInfo> {
   static Task<std::shared_ptr<ShardCtrler>> boot(
       Sim* sim, std::vector<Addr> servers, size_t me,
       std::optional<size_t> max_raft_state) {
-    auto self = std::shared_ptr<ShardCtrler>(
-        new ShardCtrler(sim, servers, me, max_raft_state));
-    self->raft_ = co_await sim->spawn(
-        raftcore::Raft::boot(sim, servers, me, self->apply_ch_));
-    sim->add_rpc_handler<kvraft::RsmRequest<ShardInfo>>(
-        [self](kvraft::RsmRequest<ShardInfo> req) {
-          return handle(self, std::move(req));
-        });
+    auto self = co_await RsmServer<ShardInfo>::boot_as<ShardCtrler>(
+        sim, std::move(servers), me, max_raft_state);
     sim->add_rpc_handler<ConfigRead>([self](ConfigRead a) {
       return handle_read(self, a);
     });
-    sim->spawn(applier(self));
     co_return self;
   }
 
  private:
+  friend class RsmServer<ShardInfo>;  // boot_as constructs us
   ShardCtrler(Sim* sim, std::vector<Addr> servers, size_t me,
               std::optional<size_t> mrs)
       : RsmServer<ShardInfo>(sim, std::move(servers), me, mrs) {}

@@ -362,7 +362,12 @@ class ShardKvServer : public std::enable_shared_from_this<ShardKvServer> {
       if (!self->pull_pending_.empty()) continue;  // finish migration first
       uint64_t want = self->config_.num + 1;
       std::optional<Config> found;
-      for (Addr a : self->ctrl_ck_->servers()) {
+      // rotate the probe start so a dead/partitioned replica taxes only
+      // every n-th round with its 100 ms timeout, not all of them
+      const auto& ctrlers = self->ctrl_ck_->servers();
+      size_t start = self->poll_round_++ % ctrlers.size();
+      for (size_t k = 0; k < ctrlers.size(); k++) {
+        Addr a = ctrlers[(start + k) % ctrlers.size()];
         auto rep = co_await self->sim_->call_timeout(
             a, shard_ctrler::ConfigRead{want}, 100 * MSEC);
         if (rep && rep->ok) {
@@ -663,6 +668,7 @@ class ShardKvServer : public std::enable_shared_from_this<ShardKvServer> {
   Addr addr_;
   Gid gid_;
   std::optional<size_t> max_raft_state_;
+  uint64_t poll_round_ = 0;  // rotates the ConfigRead probe start
   Channel<ApplyMsg> apply_ch_;
   std::shared_ptr<Raft> raft_;
   uint64_t applied_ = 0;

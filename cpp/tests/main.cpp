@@ -6,7 +6,9 @@
 //     seed and compare the accumulated simulator trace hashes; any
 //     schedule-dependent behavior fails loudly.
 #include <chrono>
+#include <csignal>
 #include <cstring>
+#include <unistd.h>
 
 #include "../simcore/simcore.h"
 #include "framework.h"
@@ -14,13 +16,31 @@
 namespace {
 uint64_t g_hash_acc = 0;
 const char* g_current_test = "?";
+unsigned g_alarm_s = 0;  // SIGALRM backstop budget (0 = disabled)
+
+// The in-loop watchdog (Sim::run) can only fire between events; a CPU-bound
+// or blocked handler never returns to it. SIGALRM is the backstop for that
+// class: it interrupts anything and still names the test. Handler is
+// async-signal-safe (write + _exit only).
+extern "C" void wdog_alarm_handler(int) {
+  auto put = [](const char* s) {
+    ssize_t r = write(2, s, std::strlen(s));
+    (void)r;
+  };
+  put("[WDOG ] test ");
+  put(g_current_test);
+  put(" hit the SIGALRM real-time backstop (CPU-bound or blocked hang)\n");
+  _exit(124);
+}
 
 void run_once(const mtest::TestCase& t, uint64_t s) {
   std::printf("[ RUN  ] %s  MADTPU_TEST_SEED=%llu\n", t.name,
               (unsigned long long)s);
   std::fflush(stdout);
   g_current_test = t.name;
+  if (g_alarm_s) alarm(g_alarm_s);
   t.fn(s);
+  if (g_alarm_s) alarm(0);
   std::printf("[ OK   ] %s\n", t.name);
   std::fflush(stdout);
 }
@@ -50,6 +70,11 @@ int main(int argc, char** argv) {
     wd.real_cap_s = std::atof(c);
   if (const char* c = std::getenv("MADTPU_TEST_VIRT_CAP"))
     wd.virt_cap_s = std::atof(c);
+  if (wd.real_cap_s > 0) {
+    std::signal(SIGALRM, wdog_alarm_handler);
+    // slack so the in-loop check (with virt detail) fires first when it can
+    g_alarm_s = unsigned(wd.real_cap_s + wd.real_cap_s / 8 + 2);
+  }
   const char* det_env = std::getenv("MADTPU_TEST_CHECK_DETERMINISTIC");
   bool check_det = det_env && det_env[0] && det_env[0] != '0';
   if (check_det)

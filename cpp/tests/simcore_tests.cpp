@@ -254,3 +254,16 @@ MT_TEST(wdog_selftest_wedge) {
   };
   MT_ASSERT(sim.run(body(&sim)));
 }
+
+// ---- SIGALRM backstop self-test: a CPU-bound spin that never returns to
+// the event loop, so the in-sim watchdog cannot fire — only the runner's
+// alarm can. Excluded from run-all like the wedge above.
+MT_TEST(wdog_selftest_spin) {
+  Sim sim(seed);
+  auto body = [](Sim*) -> Task<void> {
+    for (volatile uint64_t i = 0;; i++) {
+    }  // never yields
+    co_return;
+  };
+  MT_ASSERT(sim.run(body(&sim)));
+}

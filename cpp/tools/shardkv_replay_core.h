@@ -36,6 +36,11 @@ struct AliveEvent {
   uint64_t mask = 0;
 };
 
+struct FlipEvent {
+  uint64_t tick = 0;  // commit tick of the flip (TPU slot_tick)
+  int gid = 0;        // group index whose membership flips (0..G-1)
+};
+
 struct Schedule {
   int groups = 0;
   int nodes = 0;
@@ -45,8 +50,16 @@ struct Schedule {
   std::string bug = "none";
   std::string raft_bug;             // raft-layer planted bug (MADTPU_BUG,
   //                                   raftcore raft.cpp / config.py RAFT_BUGS)
+  // mode "schedule": reproduce the TPU's pre-drawn owner maps via Move ops.
+  // mode "computed": the TPU's computed-ctrler composite — drive the REAL
+  // 4A service with Join/Leave derived from the committed membership-flip
+  // stream, so the C++ ctrler COMPUTES every config through its own
+  // rebalance (server.rs:16-18 composed with shardkv server.rs:12-18).
+  std::string mode = "schedule";
+  std::string ctrl_bug = "none";    // 4A planted bug (MADTPU_CTRLER_BUG)
   std::vector<CfgEvent> cfgs;       // sorted by tick
   std::vector<AliveEvent> alives;   // sorted by tick
+  std::vector<FlipEvent> flips;     // sorted by tick (mode "computed")
 };
 
 inline bool parse_schedule(FILE* f, Schedule* out) {
@@ -77,6 +90,20 @@ inline bool parse_schedule(FILE* f, Schedule* out) {
       char b[64] = {0};
       if (std::sscanf(line, "%*s %63s", b) == 1) out->raft_bug = b;
       if (!madtpu_tools::is_known_raft_bug(out->raft_bug)) return false;
+    } else if (!std::strcmp(kw, "mode")) {
+      char m[64] = {0};
+      if (std::sscanf(line, "%*s %63s", m) == 1) out->mode = m;
+      if (out->mode != "schedule" && out->mode != "computed") return false;
+    } else if (!std::strcmp(kw, "ctrl_bug")) {
+      char b[64] = {0};
+      if (std::sscanf(line, "%*s %63s", b) == 1) out->ctrl_bug = b;
+      // same whitelist-is-the-name-table guard as the service bug above
+      if (!shard_ctrler::is_known_ctrler_bug(out->ctrl_bug)) return false;
+    } else if (!std::strcmp(kw, "flip")) {
+      FlipEvent ev;
+      if (std::sscanf(line, "%*s %" SCNu64 " %d", &ev.tick, &ev.gid) != 2)
+        continue;
+      out->flips.push_back(ev);
     } else if (!std::strcmp(kw, "cfg")) {
       CfgEvent ev;
       int consumed = 0;
@@ -107,6 +134,8 @@ inline bool parse_schedule(FILE* f, Schedule* out) {
       if (o < 0 || o >= out->groups) return false;
   for (const auto& ev : out->alives)
     if (ev.group < 0 || ev.group >= out->groups) return false;
+  for (const auto& ev : out->flips)
+    if (ev.gid < 0 || ev.gid >= out->groups) return false;
   return true;
 }
 
@@ -196,6 +225,84 @@ inline Task<void> config_driver(Sim* sim, ShardKvTester* t,
   }
 }
 
+// Composite mode: drive the real 4A service with Join/Leave ops DERIVED
+// from the TPU's committed membership-flip stream, at the flips' commit
+// ticks — the C++ ctrler COMPUTES every owner map through its own rebalance
+// and the groups chain through those computed configs with the full
+// migration protocol. Flip semantics mirror the TPU walker: toggle the
+// group's membership, never emptying the member set.
+inline Task<void> computed_config_driver(Sim* sim, ShardKvTester* t,
+                                  std::shared_ptr<CtrlerClerk> ck,
+                                  const Schedule* sch, uint64_t end_ns) {
+  std::vector<int> all;
+  for (int g = 0; g < sch->groups; g++) all.push_back(g);
+  co_await t->joins(all);  // TPU config 0: every group is a member
+  std::vector<bool> member(sch->groups, true);
+  int n_mem = sch->groups;
+  for (const auto& ev : sch->flips) {
+    uint64_t at = ev.tick * sch->ms_per_tick * MSEC;
+    if (at >= end_ns) break;
+    if (at > sim->now()) co_await sim->sleep(at - sim->now());
+    if (member[ev.gid]) {
+      if (n_mem <= 1) continue;  // >=1 member floor (walker semantics)
+      co_await t->leave(ev.gid);
+      member[ev.gid] = false;
+      n_mem--;
+    } else {
+      co_await t->join(ev.gid);
+      member[ev.gid] = true;
+      n_mem++;
+    }
+  }
+}
+
+// The composite divergence class: replay the SAME flip-derived op stream
+// into two ShardInfo replicas with rotated tie-breaks (the ctrler-leg
+// idiom, ctrler_replay_core.h) — under rotate_tiebreak their config
+// histories must disagree, which is exactly the divergence the TPU's
+// composite oracle (VIOLATION_SHARD_CTRL_STALE) flags when a 4B group
+// adopts a rotated replica's map.
+inline int flips_diverge_across_replicas(const Schedule& sch) {
+  using shard_ctrler::CtrlOp;
+  using shard_ctrler::Gid;
+  using shard_ctrler::ShardInfo;
+  if (sch.ctrl_bug != "rotate_tiebreak") return 0;
+  ShardInfo a, b;
+  std::vector<bool> member(sch.groups, true);
+  int n_mem = sch.groups;
+  auto srvs_of = [](Gid gid) {
+    return std::vector<Addr>{make_addr(0, 1, unsigned(gid - 100), 0)};
+  };
+  std::map<Gid, std::vector<Addr>> all;
+  for (int g = 0; g < sch.groups; g++) all[100 + g] = srvs_of(100 + g);
+  auto apply_both = [&](const CtrlOp& op) {
+    madtpu_tools::EnvGuard bg("MADTPU_CTRLER_BUG", "rotate_tiebreak");
+    {
+      madtpu_tools::EnvGuard rg("MADTPU_CTRLER_ROT", "0");
+      a.apply(op);
+    }
+    {
+      madtpu_tools::EnvGuard rg("MADTPU_CTRLER_ROT", "1");
+      b.apply(op);
+    }
+  };
+  apply_both(CtrlOp::join(all));
+  for (const auto& ev : sch.flips) {
+    Gid gid = 100 + ev.gid;
+    if (member[ev.gid]) {
+      if (n_mem <= 1) continue;
+      apply_both(CtrlOp::leave({gid}));
+      member[ev.gid] = false;
+      n_mem--;
+    } else {
+      apply_both(CtrlOp::join({{gid, srvs_of(gid)}}));
+      member[ev.gid] = true;
+      n_mem++;
+    }
+  }
+  return a.configs == b.configs ? 0 : 1;
+}
+
 inline Task<void> fault_driver(Sim* sim, ShardKvTester* t, const Schedule* sch,
                         uint64_t end_ns) {
   std::vector<uint64_t> alive(sch->groups, ~0ull);
@@ -222,7 +329,10 @@ inline Task<void> replay_driver(Sim* sim, ShardKvTester* t, Flags* fl,
       9000);
   std::vector<simcore::TaskRef<void>> tasks;
   tasks.push_back(sim->spawn(
-      Addr(make_addr(0, 0, 3, 90)), config_driver(sim, t, ctrl_ck, sch, end_ns)));
+      Addr(make_addr(0, 0, 3, 90)),
+      sch->mode == "computed"
+          ? computed_config_driver(sim, t, ctrl_ck, sch, end_ns)
+          : config_driver(sim, t, ctrl_ck, sch, end_ns)));
   tasks.push_back(
       sim->spawn(Addr(make_addr(0, 0, 3, 91)), fault_driver(sim, t, sch, end_ns)));
   for (int c = 0; c < 8; c++)
@@ -241,6 +351,17 @@ inline std::string run_schedule(const Schedule& sch) {
       "MADTPU_SHARDKV_BUG", sch.bug != "none" ? sch.bug.c_str() : nullptr);
   madtpu_tools::EnvGuard raft_guard(
       "MADTPU_BUG", !sch.raft_bug.empty() ? sch.raft_bug.c_str() : nullptr);
+  // Composite mode's divergence class is checked OUTSIDE the service run
+  // (two rotated ShardInfo replicas over the same committed op stream) —
+  // the in-process service can only run ONE rot at a time, so the full
+  // replay runs it uniformly (rot 1: rotated-but-consistent maps, the
+  // liveness half) while `diverged` carries the per-replica class.
+  int diverged = sch.mode == "computed" ? flips_diverge_across_replicas(sch) : 0;
+  madtpu_tools::EnvGuard cbg(
+      "MADTPU_CTRLER_BUG",
+      sch.ctrl_bug != "none" ? sch.ctrl_bug.c_str() : nullptr);
+  madtpu_tools::EnvGuard crg(
+      "MADTPU_CTRLER_ROT", sch.ctrl_bug != "none" ? "1" : nullptr);
   std::string out;
   if (sch.groups <= ShardKvTester::N_GROUPS) {
     Sim sim(sch.seed);
@@ -251,10 +372,10 @@ inline std::string run_schedule(const Schedule& sch) {
       char buf[512];
       std::snprintf(
           buf, sizeof buf,
-          "{\"dup_apply\": %d, \"stale_read\": %d, \"ops\": %" PRIu64
-          ", \"gets\": %" PRIu64 ", \"first_violation_ms\": %" PRIu64
-          ", \"rpcs\": %" PRIu64 "}",
-          (int)fl.dup_apply, (int)fl.stale_read, fl.ops, fl.gets,
+          "{\"dup_apply\": %d, \"stale_read\": %d, \"diverged\": %d, "
+          "\"ops\": %" PRIu64 ", \"gets\": %" PRIu64
+          ", \"first_violation_ms\": %" PRIu64 ", \"rpcs\": %" PRIu64 "}",
+          (int)fl.dup_apply, (int)fl.stale_read, diverged, fl.ops, fl.gets,
           fl.first_violation_ms, sim.msg_count() / 2);
       out = buf;
     }

@@ -198,7 +198,10 @@ def cmd_kv_fuzz(args):
         p_client_cmd=0.0, compact_at_commit=False, compact_every=16
     )
     kcfg = _with_service_bug(
-        KvConfig(p_get=args.p_get, p_put=args.p_put), args.service_bug
+        KvConfig(p_get=args.p_get, p_put=args.p_put,
+                 p_follow_hint=args.p_follow_hint,
+                 retry_wait=args.retry_wait),
+        args.service_bug,
     )
 
     mesh = _mesh(args)
@@ -245,15 +248,25 @@ def cmd_shardkv_fuzz(args):
         bug=args.bug,
     )
 
-    kcfg = _with_service_bug(
-        ShardKvConfig(p_get=args.p_get, p_put=args.p_put,
-                      live_ctrler=args.live_ctrler), args.service_bug
-    )
+    # mode prerequisites BEFORE config construction — ShardKvConfig's own
+    # __post_init__ validation would otherwise surface as a raw traceback
     if args.service_bug == "stale_ctrler_read" and not args.live_ctrler:
         raise SystemExit(
             "--service-bug stale_ctrler_read needs --live-ctrler: the bug "
             "lives in the query path to the on-device replicated controller"
         )
+    if args.service_bug == "rotate_tiebreak" and not args.computed_ctrler:
+        raise SystemExit(
+            "--service-bug rotate_tiebreak needs --computed-ctrler: the bug "
+            "rotates each controller replica's rebalance order, which only "
+            "exists when config content is computed on-device"
+        )
+    kcfg = _with_service_bug(
+        ShardKvConfig(p_get=args.p_get, p_put=args.p_put,
+                      live_ctrler=args.live_ctrler,
+                      computed_ctrler=args.computed_ctrler),
+        args.service_bug,
+    )
 
     mesh = _mesh(args)
 
@@ -446,6 +459,14 @@ def main(argv=None) -> int:
     service_common(sp, 512)
     sp.add_argument("--p-get", type=float, default=0.3)
     sp.add_argument("--p-put", type=float, default=0.2)
+    sp.add_argument("--p-follow-hint", type=float, default=0.0,
+                    help="prob a clerk targets its believed leader (the "
+                         "NotLeader{hint} ClerkCore model) instead of a "
+                         "random node; 0 = historic random routing")
+    sp.add_argument("--retry-wait", type=int, default=0,
+                    help="ticks a clerk pauses after a submit landed at a "
+                         "leader (the 500ms call-timeout pacing); needed "
+                         "for meaningful hint-following runs")
     sp.set_defaults(fn=cmd_kv_fuzz)
 
     sp = sub.add_parser(
@@ -464,6 +485,11 @@ def main(argv=None) -> int:
                     help="configs ride an on-device replicated controller "
                          "raft cluster (announce/query protocol) instead of "
                          "the schedule tensor")
+    sp.add_argument("--computed-ctrler", action="store_true",
+                    help="the controller cluster's apply machine IS the 4A "
+                         "state machine: membership flips ride its raft and "
+                         "config content is computed by the shared 4A "
+                         "rebalance (supersedes --live-ctrler)")
     sp.set_defaults(fn=cmd_shardkv_fuzz)
 
     sp = sub.add_parser(

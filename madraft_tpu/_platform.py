@@ -24,6 +24,8 @@ runtime before any test body runs).
 
 from __future__ import annotations
 
+import datetime
+import json
 import os
 import subprocess
 import sys
@@ -36,6 +38,31 @@ _PROBE_SNIPPET = (
     "d = jax.devices()\n"
     "print('MADTPU_PROBE_OK', d[0])\n"
 )
+
+# Every probe outcome is appended here (round-4 verdict, weak #6: outage
+# claims must be checkable from an artifact, not narrative). One JSON line
+# per probe: {ts, plat, ok, latency_s, detail}. Committed with the repo.
+_STATUS_LOG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "TUNNEL_STATUS.jsonl",
+)
+
+
+def _record_probe(plat, ok: bool, latency_s: float, detail: str) -> None:
+    row = {
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"
+        ),
+        "plat": plat or "default(axon)",
+        "ok": ok,
+        "latency_s": round(latency_s, 1),
+        "detail": detail,
+    }
+    try:
+        with open(_STATUS_LOG, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass  # a read-only checkout must not break the probe itself
 
 
 def resolve_platform(explicit: str | None = None) -> str | None:
@@ -76,6 +103,7 @@ def probe_backend(plat: str | None, timeout_s: float = 90.0):
     success, the failure mode ("hang >Ns" / stderr tail) otherwise.
     """
     code = _PROBE_SNIPPET.format(plat=plat)
+    t0 = time.time()
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],
@@ -84,12 +112,18 @@ def probe_backend(plat: str | None, timeout_s: float = 90.0):
             timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
-        return False, f"backend init hang (> {timeout_s:.0f}s)"
+        detail = f"backend init hang (> {timeout_s:.0f}s)"
+        _record_probe(plat, False, time.time() - t0, detail)
+        return False, detail
     for line in r.stdout.splitlines():
         if line.startswith("MADTPU_PROBE_OK"):
-            return True, line.split(" ", 1)[1]
+            detail = line.split(" ", 1)[1]
+            _record_probe(plat, True, time.time() - t0, detail)
+            return True, detail
     tail = (r.stderr or r.stdout).strip().splitlines()
-    return False, tail[-1] if tail else f"probe exit {r.returncode}"
+    detail = tail[-1] if tail else f"probe exit {r.returncode}"
+    _record_probe(plat, False, time.time() - t0, detail)
+    return False, detail
 
 
 def init_backend_with_retry(

@@ -369,12 +369,22 @@ class ShardKvSchedule:
     bug: str = "none"  # none | drop_dup_table | serve_frozen (service layer)
     raft_bug: str = ""  # raft-layer planted bug (config.py RAFT_BUGS ->
     #                     MADTPU_BUG), same contract as the raw-raft leg
+    # mode "schedule": reproduce the pre-drawn owner maps via Move ops.
+    # mode "computed": composite replay — the committed membership-flip
+    # stream drives REAL Join/Leave through the C++ 4A service, which then
+    # COMPUTES every config via its own rebalance (the computed_ctrler
+    # composition, shard_ctrler/server.rs:16-18 + shardkv/server.rs:12-18).
+    mode: str = "schedule"
+    ctrl_bug: str = "none"  # 4A planted bug (MADTPU_CTRLER_BUG name table)
     cfg_events: list[tuple[int, list[int]]] = dataclasses.field(
         default_factory=list
     )  # (activation tick, owner group per shard)
     alive_events: list[tuple[int, int, int]] = dataclasses.field(
         default_factory=list
     )  # (tick, group, bitmask)
+    flip_events: list[tuple[int, int]] = dataclasses.field(
+        default_factory=list
+    )  # (commit tick, flipped group) — mode "computed"
     violations: int = 0
     first_violation_tick: int = -1
 
@@ -390,8 +400,14 @@ class ShardKvSchedule:
         ]
         if self.raft_bug:
             lines.append(f"raft_bug {self.raft_bug}")
+        if self.mode != "schedule":
+            lines.append(f"mode {self.mode}")
+        if self.ctrl_bug != "none":
+            lines.append(f"ctrl_bug {self.ctrl_bug}")
         for t, owners in self.cfg_events:
             lines.append(f"cfg {t} " + " ".join(str(o) for o in owners))
+        for t, g in self.flip_events:
+            lines.append(f"flip {t} {g}")
         for t, g, m in self.alive_events:
             lines.append(f"ev {t} alive {g} {m:x}")
         return "\n".join(lines) + "\n"
@@ -431,14 +447,29 @@ def extract_shardkv_schedule(cfg, kcfg, seed: int, cluster_id: int,
             else "none"
         ),
         raft_bug=cfg.bug,
+        mode="computed" if kcfg.computed_ctrler else "schedule",
+        ctrl_bug=(
+            "rotate_tiebreak" if kcfg.bug_rotate_tiebreak else "none"
+        ),
     )
-    cfg_tick = np.asarray(final.cfg_tick)
-    cfg_owner = np.asarray(final.cfg_owner)
-    for i in range(cfg_tick.shape[0]):
-        t = int(cfg_tick[i])
-        if t >= n_ticks:
-            continue
-        sched.cfg_events.append((t, [int(o) for o in cfg_owner[i]]))
+    if kcfg.computed_ctrler:
+        # the composite interchange: the COMMITTED flip stream (slot order,
+        # commit ticks) — the C++ side derives real Join/Leave from it and
+        # computes the configs through its own 4A rebalance
+        win = np.asarray(final.win_var)      # [NCFG] committed flip gids
+        stick = np.asarray(final.slot_tick)  # [NCFG] commit ticks
+        for j in range(1, win.shape[0]):
+            if win[j] < 0 or stick[j] < 0 or stick[j] >= n_ticks:
+                continue
+            sched.flip_events.append((int(stick[j]), int(win[j])))
+    else:
+        cfg_tick = np.asarray(final.cfg_tick)
+        cfg_owner = np.asarray(final.cfg_owner)
+        for i in range(cfg_tick.shape[0]):
+            t = int(cfg_tick[i])
+            if t >= n_ticks:
+                continue
+            sched.cfg_events.append((t, [int(o) for o in cfg_owner[i]]))
     prev = [(1 << cfg.n_nodes) - 1] * kcfg.n_groups
     for t in range(1, n_ticks + 1):
         for g in range(kcfg.n_groups):
@@ -492,8 +523,11 @@ def replay_shardkv_on_simcore(
 def shardkv_classes_match(tpu_violations: int, cpp_report: dict) -> bool:
     """Class map for the sharded stack: the TPU walker-divergence bit (the
     exactly-once-across-migration oracle) corresponds to the C++ client-side
-    dup_apply flag; the TPU interval-oracle bit to stale_read."""
+    dup_apply flag; the TPU interval-oracle bit to stale_read; the composite
+    adopted-vs-canonical bit (computed_ctrler + rotate_tiebreak) to the C++
+    dual-replica config-history divergence over the same committed ops."""
     from madraft_tpu.tpusim.shardkv import (
+        VIOLATION_SHARD_CTRL_STALE,
         VIOLATION_SHARD_DIVERGE,
         VIOLATION_SHARD_STALE_READ,
     )
@@ -501,6 +535,10 @@ def shardkv_classes_match(tpu_violations: int, cpp_report: dict) -> bool:
     if tpu_violations & VIOLATION_SHARD_DIVERGE and cpp_report["dup_apply"]:
         return True
     if tpu_violations & VIOLATION_SHARD_STALE_READ and cpp_report["stale_read"]:
+        return True
+    if tpu_violations & VIOLATION_SHARD_CTRL_STALE and cpp_report.get(
+        "diverged"
+    ):
         return True
     return False
 

@@ -65,6 +65,7 @@ _APPEND, _GET, _PUT = 0, 1, 2
 # PRNG site ids, disjoint from step.py's _S_STEP_BLOCK (0).
 _S_CLERK_START, _S_CLERK_TARGET, _S_CLERK_RETRY, _S_CLERK_KEY = 8, 9, 10, 11
 _S_CLERK_KIND = 14
+_S_CLERK_HINT = 15
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,6 +92,30 @@ class KvConfig:
     #                               (possibly lagging) state at submit time —
     #                               the classic read-from-follower bug the
     #                               linearizability oracle must catch
+    # NotLeader{hint} routing (the reference clerk follows leader hints,
+    # /root/reference/src/kvraft/msg.rs:10-18, client.rs:32-63). 0.0 keeps
+    # the historic random routing; with p > 0 a submitting clerk targets its
+    # believed leader with probability p: a submit that reaches the leader
+    # pins the belief, one that reaches an alive non-leader adopts that
+    # node's hint (the leader of the node's own term — "whoever I heard
+    # from"), and a dead target clears it.
+    p_follow_hint: float = 0.0
+    retry_wait: int = 0  # ticks a clerk pauses after its submit LANDED at an
+    #                      alive leader before re-submitting — the ClerkCore
+    #                      await-reply pacing (client.rs:56's 500 ms call
+    #                      timeout). 0 keeps the historic fire-at-p_retry
+    #                      model; without it, hint-following clerks spam the
+    #                      leader with duplicate appends of the SAME op and
+    #                      flow-control backpressure throttles the whole
+    #                      cluster (measured: hints at 0.9 were ~0.6x random
+    #                      — the model, not the protocol)
+    bug_stale_hint: bool = False  # nodes hint the next FOLLOWER in the ring
+    #                               instead of the leader — hint-following
+    #                               clerks chase a leaderless cycle (the
+    #                               deposed-leaders-hint-each-other loop);
+    #                               caught as a measured liveness collapse
+    #                               vs random routing (tests), not a safety
+    #                               oracle: hints only steer routing
 
     def __post_init__(self):
         if self.p_get + self.p_put > 1.0:
@@ -118,9 +143,12 @@ class KvConfig:
             p_get=jnp.float32(self.p_get),
             p_put=jnp.float32(self.p_put),
             p_retry=jnp.float32(self.p_retry),
+            p_follow_hint=jnp.float32(self.p_follow_hint),
+            retry_wait=jnp.int32(self.retry_wait),
             bug_skip_dedup=jnp.bool_(self.bug_skip_dedup),
             bug_apply_uncommitted=jnp.bool_(self.bug_apply_uncommitted),
             bug_stale_read=jnp.bool_(self.bug_stale_read),
+            bug_stale_hint=jnp.bool_(self.bug_stale_hint),
         )
 
     def static_key(self) -> "KvConfig":
@@ -138,9 +166,12 @@ class KvKnobs(NamedTuple):
     p_get: jax.Array
     p_put: jax.Array
     p_retry: jax.Array
+    p_follow_hint: jax.Array
+    retry_wait: jax.Array
     bug_skip_dedup: jax.Array
     bug_apply_uncommitted: jax.Array
     bug_stale_read: jax.Array
+    bug_stale_hint: jax.Array
 
     def broadcast(self, n_clusters: int) -> "KvKnobs":
         return KvKnobs(*(jnp.broadcast_to(x, (n_clusters,)) for x in self))
@@ -156,6 +187,10 @@ class KvState(NamedTuple):
     clerk_key: jax.Array     # i32 key of the outstanding op
     clerk_kind: jax.Array    # i32 op kind: _APPEND, _GET, or _PUT
     clerk_acked: jax.Array   # i32 highest committed (acked) seq
+    clerk_leader: jax.Array  # i32 believed leader node (-1 unknown) — the
+    #                          reference ClerkCore's leader_ cache, fed by
+    #                          NotLeader{hint} replies (client.rs:32-63)
+    clerk_wait: jax.Array    # i32 await-reply countdown (see retry_wait)
     # --- reads-linearizability oracle state ---
     # The log totally orders mutations (Appends and Puts), so key k's
     # observable state IS its committed MUTATION VERSION — the count of
@@ -228,6 +263,8 @@ def init_kv_cluster(
         clerk_key=jnp.zeros((nc,), I32),
         clerk_kind=jnp.zeros((nc,), I32),
         clerk_acked=jnp.zeros((nc,), I32),
+        clerk_leader=jnp.full((nc,), -1, I32),
+        clerk_wait=jnp.zeros((nc,), I32),
         truth_count=jnp.zeros((nk,), I32),
         truth_max_seq=jnp.zeros((nc,), I32),
         clerk_get_lo=jnp.zeros((nc,), I32),
@@ -493,9 +530,21 @@ def kv_step(
     clerk_get_obs = jnp.where(start, -1, clerk_get_obs)
     clerk_out = clerk_out | start
     retry = clerk_out & (
-        start | jax.random.bernoulli(kk[2], kkn.p_retry, (nc,))
+        start
+        | (
+            jax.random.bernoulli(kk[2], kkn.p_retry, (nc,))
+            & (ks.clerk_wait <= 0)
+        )
     )
     target = jax.random.randint(kk[3], (nc,), 0, n, dtype=I32)
+    # NotLeader{hint} routing (msg.rs:10-18): with p_follow_hint, a clerk
+    # holding a leader belief targets it instead of the random draw.
+    kk_h = jax.random.split(jax.random.fold_in(key, _S_CLERK_HINT))
+    follow = (
+        jax.random.bernoulli(kk_h[0], kkn.p_follow_hint, (nc,))
+        & (ks.clerk_leader >= 0)
+    )
+    target = jnp.where(follow, jnp.clip(ks.clerk_leader, 0, n - 1), target)
 
     # Bug mode (dynamic knob; a no-op mask when off): the contacted node —
     # leader or not — serves the Get immediately from its own (possibly
@@ -566,6 +615,41 @@ def kv_step(
         log_val = jnp.where(hit, v, log_val)
         log_len = jnp.where(ok, log_len + 1, log_len)
 
+    # The submit's "reply" teaches the clerk where the leader is (ClerkCore
+    # leader_ cache, client.rs:32-63): reaching the leader pins the belief;
+    # an alive non-leader answers NotLeader{hint} — its hint is the leader
+    # of its OWN term ("whoever I heard from"; -1 if it knows none); a dead
+    # target times out and the belief clears. Under bug_stale_hint nodes
+    # hint the next FOLLOWER in the ring — skipping the real leader — so
+    # hint-followers chase a leaderless cycle (hints steer routing only;
+    # the failure mode is measured liveness collapse, tests).
+    is_lead_n = s.alive & (s.role == LEADER)          # [N]
+    lead_term = jnp.max(jnp.where(is_lead_n, s.term, -1))
+    lead_node = jnp.argmax(is_lead_n & (s.term == lead_term)).astype(I32)
+    hint_ok = is_lead_n.any() & (s.term == lead_term)  # [N] per contacted node
+    ring = (me + 1) % n
+    ring = jnp.where(ring == lead_node, (ring + 1) % n, ring)
+    hint_n = jnp.where(
+        kkn.bug_stale_hint, ring, jnp.where(hint_ok, lead_node, -1)
+    )  # [N]
+    tgt_oh2 = me[None, :] == target[:, None]           # [nc, n]
+    tgt_alive = jnp.any(tgt_oh2 & s.alive[None, :], axis=1)
+    tgt_is_lead = jnp.any(tgt_oh2 & is_lead_n[None, :], axis=1)
+    tgt_hint = jnp.sum(jnp.where(tgt_oh2, hint_n[None, :], 0), axis=1)
+    clerk_leader = jnp.where(
+        ~retry, ks.clerk_leader,
+        jnp.where(
+            tgt_is_lead, target,
+            jnp.where(tgt_alive, tgt_hint, -1),
+        ),
+    )
+    # await-reply pacing: a submit that reached an alive leader pauses the
+    # clerk for retry_wait ticks (one outstanding RPC, client.rs:56)
+    clerk_wait = jnp.where(
+        retry & tgt_is_lead, kkn.retry_wait,
+        jnp.maximum(ks.clerk_wait - 1, 0),
+    )
+
     raft = s._replace(
         log_term=log_term,
         log_val=log_val,
@@ -582,6 +666,8 @@ def kv_step(
         clerk_key=clerk_key,
         clerk_kind=clerk_kind,
         clerk_acked=clerk_acked,
+        clerk_leader=clerk_leader,
+        clerk_wait=clerk_wait,
         truth_count=truth_count,
         truth_max_seq=truth_max_seq,
         clerk_get_lo=clerk_get_lo,
@@ -687,14 +773,17 @@ def _validate_kv_knobs(kkn) -> None:
     from madraft_tpu.tpusim.engine import validate_bool_bugs, validate_probs
 
     k = jax.tree.map(np.asarray, kkn)
-    validate_probs(k, ("p_op", "p_get", "p_put", "p_retry"), "kv")
+    validate_probs(
+        k, ("p_op", "p_get", "p_put", "p_retry", "p_follow_hint"), "kv"
+    )
     if (k.p_get + k.p_put > 1.0).any():
         raise ValueError(
             "p_get + p_put must stay <= 1 per cluster (one uniform draw "
             "splits Get/Put/Append)"
         )
     validate_bool_bugs(
-        k, ("bug_skip_dedup", "bug_apply_uncommitted", "bug_stale_read"), "kv"
+        k, ("bug_skip_dedup", "bug_apply_uncommitted", "bug_stale_read",
+            "bug_stale_hint"), "kv"
     )
 
 

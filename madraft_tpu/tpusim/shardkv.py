@@ -75,6 +75,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
+from madraft_tpu.tpusim.ctrler import _rebalance as _ctrl_rebalance
 from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
 from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 
@@ -116,6 +117,7 @@ _S_CFGGEN = 19
 _S_NET_PULL = 20
 _S_CTRL = 21         # live-ctrler raft cluster stream
 _S_ANN = 22          # announcer / phantom-announcer / query draws
+_S_FLIP = 23         # computed-ctrler flip-op workload schedule
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,6 +164,36 @@ class ShardKvConfig:
     live_ctrler: bool = False
     p_announce: float = 0.5     # truth announcer submits this tick
     p_phantom: float = 0.3      # phantom announcer submits this tick
+    # --- computed replicated controller (STATIC: supersedes live_ctrler's
+    # pre-drawn config CONTENT). The controller cluster's apply machine IS
+    # the 4A state machine: membership FLIP ops (single-gid Join-or-Leave,
+    # the reference's Join/Leave pair as one self-normalizing op) ride the
+    # controller raft; config j's owner map is COMPUTED at walk time by the
+    # 4A closed-form rebalance (ctrler.py _rebalance — the same function the
+    # 4A layer fuzzes and the C++ backend mirrors) from whatever op COMMITTED
+    # at slot j. Two announcers race competing flips per slot, so the
+    # committed ORDER decides config content (concurrent Join/Leave
+    # proposals, /root/reference/src/shard_ctrler/server.rs:16-18), and the
+    # 4B groups consume the computed service exactly as the reference's
+    # servers consume the ctrler (/root/reference/src/shardkv/server.rs:
+    # 12-18). Under bug_rotate_tiebreak each controller REPLICA computes its
+    # own rotated deficit-fill order (the HashMap-iteration-order classic),
+    # a group adopts the map of whichever replica answered its query, and
+    # the 4A bug propagates into 4B migration divergence — caught by the
+    # walker's adopted-vs-canonical map check (VIOLATION_SHARD_CTRL_STALE)
+    # and behaviorally by the ownership-exclusivity oracle.
+    computed_ctrler: bool = False
+    bug_rotate_tiebreak: bool = False  # computed-ctrler composite bug (4A
+    #                                    rotate propagating into 4B)
+    # WrongGroup re-query (the reference clerk re-queries the ctrler the
+    # moment a group answers WrongGroup, /root/reference/src/shardkv/
+    # client.rs:16-25). Modeled: a submit that reaches an ALIVE LEADER of
+    # the targeted group for a shard it is not serving marks the clerk, and
+    # a marked clerk re-learns the latest config NEXT tick instead of
+    # waiting for its p_cfg_learn draw. Off by default (historic visibility-
+    # draw model — MIGRATION.md "known model differences"); the liveness
+    # delta is pinned by tests either way.
+    requery_wrong_group: bool = False
     # Oracle-validation bug modes (False = correct service).
     bug_skip_freeze: bool = False    # lost shards keep serving at the nodes
     bug_drop_dup_table: bool = False  # INSTALL resets the migrated dup table
@@ -182,6 +214,32 @@ class ShardKvConfig:
         if self.p_get + self.p_put > 1.0:
             raise ValueError(
                 f"p_get ({self.p_get}) + p_put ({self.p_put}) must stay <= 1"
+            )
+        if self.computed_ctrler and self.live_ctrler:
+            raise ValueError(
+                "computed_ctrler supersedes live_ctrler — enable one"
+            )
+        if self.computed_ctrler:
+            from madraft_tpu.tpusim.ctrler import N_SHARDS as CTRL_NS
+
+            if self.n_shards != CTRL_NS:
+                raise ValueError(
+                    f"computed_ctrler reuses the 4A rebalance (ctrler.py), "
+                    f"which is fixed at N_SHARDS={CTRL_NS}; got n_shards="
+                    f"{self.n_shards}"
+                )
+            if self.bug_stale_ctrler_read:
+                raise ValueError(
+                    "bug_stale_ctrler_read is a live_ctrler-mode oracle "
+                    "validation; computed_ctrler's planted bug is "
+                    "bug_rotate_tiebreak"
+                )
+        elif self.bug_rotate_tiebreak:
+            raise ValueError(
+                "bug_rotate_tiebreak plants a per-replica rebalance "
+                "divergence in the COMPUTED controller — it needs "
+                "computed_ctrler=True (otherwise the knob would silently "
+                "do nothing and read as an oracle failure)"
             )
         # packed ops must stay below NOOP_CMD (which decodes as the unused
         # kind 7) so no client op ever aliases the no-op or overflows i32
@@ -215,6 +273,8 @@ class ShardKvConfig:
             bug_drop_dup_table=jnp.bool_(self.bug_drop_dup_table),
             bug_serve_frozen=jnp.bool_(self.bug_serve_frozen),
             bug_stale_ctrler_read=jnp.bool_(self.bug_stale_ctrler_read),
+            bug_rotate_tiebreak=jnp.bool_(self.bug_rotate_tiebreak),
+            requery_wrong_group=jnp.bool_(self.requery_wrong_group),
         )
 
     def static_key(self) -> "ShardKvConfig":
@@ -229,6 +289,7 @@ class ShardKvConfig:
             n_clients=self.n_clients, n_configs=self.n_configs,
             apply_max=self.apply_max, walk_max=self.walk_max,
             live_ctrler=self.live_ctrler,
+            computed_ctrler=self.computed_ctrler,
         )
 
 
@@ -253,6 +314,8 @@ class ShardKvKnobs(NamedTuple):
     bug_drop_dup_table: jax.Array
     bug_serve_frozen: jax.Array
     bug_stale_ctrler_read: jax.Array
+    bug_rotate_tiebreak: jax.Array
+    requery_wrong_group: jax.Array
 
     def broadcast(self, n_clusters: int) -> "ShardKvKnobs":
         return ShardKvKnobs(
@@ -265,12 +328,14 @@ def _pack_op(cfg: ShardKvConfig, client, seq, shard, kind):
     return (((client * _SEQ_LIM + seq) * cfg.n_shards + shard) * 8 + kind) + 1
 
 
-def _pack_config(cfg_idx, var=0):
-    """CONFIG payload = cfg_idx*2 + variant bit. The variant records WHICH
-    committed announce the group adopted (live-ctrler mode; always 0 when
-    the controller is the schedule tensor) — the walker checks it against
-    the controller's first-committed variant (VIOLATION_SHARD_CTRL_STALE)."""
-    return ((cfg_idx * 2 + var) * 8 + _CONFIG) + 1
+def _pack_config(cfg_idx, var=0, src_lim=2):
+    """CONFIG payload = cfg_idx*src_lim + src. ``src`` records WHICH
+    committed announce variant (live-ctrler mode, src_lim=2) or WHICH
+    controller replica's computed map (computed-ctrler mode, src_lim=
+    n_nodes) the group adopted; 0 when the controller is the schedule
+    tensor — the walker checks it against the controller's canonical
+    decision (VIOLATION_SHARD_CTRL_STALE)."""
+    return ((cfg_idx * src_lim + var) * 8 + _CONFIG) + 1
 
 
 def _pack_install(cfg: ShardKvConfig, cfg_idx, shard):
@@ -281,9 +346,10 @@ def _pack_delete(cfg: ShardKvConfig, cfg_idx, shard):
     return ((cfg_idx * cfg.n_shards + shard) * 8 + _DELETE) + 1
 
 
-def _unpack(cfg: ShardKvConfig, val):
+def _unpack(cfg: ShardKvConfig, val, src_lim=2):
     """-> (kind, client, seq, shard, cfg_idx_c, cfg_idx_i, var_c); fields
-    valid per kind (var_c: the CONFIG entry's adopted-announce variant)."""
+    valid per kind (var_c: the CONFIG entry's adopted src — announce
+    variant or controller replica, see _pack_config)."""
     v = val - 1
     kind = v % 8
     payload = v // 8
@@ -291,8 +357,8 @@ def _unpack(cfg: ShardKvConfig, val):
     cs = payload // cfg.n_shards
     client = cs // _SEQ_LIM
     seq = cs % _SEQ_LIM
-    cfg_idx_c = payload // 2  # CONFIG payload
-    var_c = payload % 2
+    cfg_idx_c = payload // src_lim  # CONFIG payload
+    var_c = payload % src_lim
     cfg_idx_i = payload // cfg.n_shards  # INSTALL/DELETE payload
     return kind, client, seq, shard, cfg_idx_c, cfg_idx_i, var_c
 
@@ -316,7 +382,24 @@ class ShardKvState(NamedTuple):
     #                              variant per config; -1 = not yet committed.
     #                              THIS is the controller's decision — the
     #                              committed winner of the truth-vs-phantom
-    #                              announce race.
+    #                              announce race. (computed_ctrler: the
+    #                              committed FLIP GID of slot j instead.)
+    # --- computed replicated controller (kcfg.computed_ctrler; zeros off) ---
+    flip_a: jax.Array            # i32 [NCFG] truth announcer's flip gid per slot
+    flip_b: jax.Array            # i32 [NCFG] phantom's competing flip gid
+    slot_tick: jax.Array         # i32 [NCFG] tick slot j resolved (-1 pending)
+    cmem: jax.Array              # bool [G] canonical member mask (walker)
+    ctrl_node_owner: jax.Array   # i32 [N, NS] per-replica owner chain (walker-
+    #                              computed; replicas diverge under the
+    #                              planted rotate bug, else all canonical)
+    ctrl_maps: jax.Array         # i32 [N, NCFG, NS] write-once map history:
+    #                              replica n's computed owner map for config j
+    #                              (stable: a pure function of the committed
+    #                              prefix and n, so crash/replay re-derives it)
+    node_src: jax.Array          # i32 [G, N] replica whose map this node's
+    #                              latest CONFIG adopted (volatile, replayed)
+    snap_src: jax.Array          # i32 [G, N] persisted counterpart
+    w_src: jax.Array             # i32 [G] walker's adopted-src register
     cq_req_t: jax.Array          # i32 [G] query delivery tick (0 = none)
     cq_req_node: jax.Array       # i32 [G] targeted ctrler node
     cq_req_j: jax.Array          # i32 [G] asked config index
@@ -368,6 +451,10 @@ class ShardKvState(NamedTuple):
     clerk_shard: jax.Array
     clerk_kind: jax.Array         # i32: _APPEND, _GET, or _PUT
     clerk_cfg: jax.Array          # clerk's believed config index
+    clerk_wrong: jax.Array        # bool: last submit got WrongGroup (an
+    #                               alive leader of the targeted group does
+    #                               not serve the shard) — drives the
+    #                               requery_wrong_group re-learn
     clerk_acked: jax.Array
     # --- reads-linearizability oracle state (kv.py's design per shard:
     # a shard's state IS its accepted-mutation VERSION (appends + puts;
@@ -509,7 +596,7 @@ def init_shardkv_cluster(
     ) * jnp.ones((g, n, ns), I32)
     zgns = jnp.zeros((g, n, ns), I32)
     zggs = jnp.zeros((g, g, ns), I32)
-    if kcfg.live_ctrler:
+    if kcfg.live_ctrler or kcfg.computed_ctrler:
         ctrl = init_cluster(cfg, jax.random.fold_in(key, _S_CTRL), kn)
     else:
         # the mode is off (a STATIC choice — its own compiled program):
@@ -522,6 +609,28 @@ def init_shardkv_cluster(
                         compact_every=1),
             jax.random.fold_in(key, _S_CTRL),
         )
+    ncfg = kcfg.n_configs
+    owner0 = cfg_owner[0]
+    if kcfg.computed_ctrler:
+        # config CONTENT comes from the controller's apply machine, not the
+        # pre-drawn schedule: rows 1+ are placeholders the ctrl walker
+        # overwrites as slots commit (readers only touch rows <= the
+        # committed frontier). cfg_tick stays as the announcers' pacing.
+        cfg_owner = jnp.broadcast_to(owner0, (ncfg, ns)) + jnp.zeros(
+            (ncfg, ns), I32
+        )
+        kf = jax.random.split(jax.random.fold_in(key, _S_FLIP))
+        flip_a = jax.random.randint(kf[0], (ncfg,), 0, g, dtype=I32)
+        # the phantom's competing flip is always a DIFFERENT gid, so the
+        # committed order genuinely decides config content
+        flip_b = (
+            flip_a
+            + 1
+            + jax.random.randint(kf[1], (ncfg,), 0, max(g - 1, 1), dtype=I32)
+        ) % g
+    else:
+        flip_a = jnp.zeros((ncfg,), I32)
+        flip_b = jnp.zeros((ncfg,), I32)
     return ShardKvState(
         rafts=rafts,
         cfg_tick=cfg_tick,
@@ -530,6 +639,17 @@ def init_shardkv_cluster(
         ctrl_w_frontier=jnp.asarray(0, I32),
         ctrl_w_stalled=jnp.asarray(False, jnp.bool_),
         win_var=jnp.full((kcfg.n_configs,), -1, I32).at[0].set(0),
+        flip_a=flip_a,
+        flip_b=flip_b,
+        slot_tick=jnp.full((ncfg,), -1, I32).at[0].set(0),
+        cmem=jnp.ones((g,), jnp.bool_),
+        ctrl_node_owner=jnp.broadcast_to(owner0, (n, ns)) + jnp.zeros(
+            (n, ns), I32
+        ),
+        ctrl_maps=jnp.zeros((n, ncfg, ns), I32).at[:, 0, :].set(owner0),
+        node_src=jnp.zeros((g, n), I32),
+        snap_src=jnp.zeros((g, n), I32),
+        w_src=jnp.zeros((g,), I32),
         cq_req_t=jnp.zeros((g,), I32),
         cq_req_node=jnp.zeros((g,), I32),
         cq_req_j=jnp.zeros((g,), I32),
@@ -561,6 +681,7 @@ def init_shardkv_cluster(
         clerk_shard=jnp.zeros((nc,), I32),
         clerk_kind=jnp.zeros((nc,), I32),
         clerk_cfg=jnp.zeros((nc,), I32),
+        clerk_wrong=jnp.zeros((nc,), jnp.bool_),
         clerk_acked=jnp.zeros((nc,), I32),
         clerk_get_lo=jnp.zeros((nc,), I32),
         clerk_get_obs=jnp.full((nc,), -1, I32),
@@ -627,12 +748,16 @@ def shardkv_step(
     ctrl_w_frontier = st.ctrl_w_frontier
     ctrl_w_stalled = st.ctrl_w_stalled
     ncfgs = kcfg.n_configs
-    if kcfg.live_ctrler:
+    cfg_owner = st.cfg_owner
+    cmem, slot_tick = st.cmem, st.slot_tick
+    ctrl_node_owner, ctrl_maps = st.ctrl_node_owner, st.ctrl_maps
+    if kcfg.live_ctrler or kcfg.computed_ctrler:
         ctrl = step_cluster(
             cfg, st.ctrl, jax.random.fold_in(cluster_key, _S_CTRL), kn
         )
         lane1 = jnp.arange(cap, dtype=I32)
         csh_abs = _lane_abs(ctrl.shadow_base, cap)  # [cap]
+    if kcfg.live_ctrler:
         for _ in range(kcfg.walk_max):
             canw = ctrl_w_frontier < ctrl.shadow_len
             posw = _slot(ctrl_w_frontier + 1, cap)
@@ -656,12 +781,87 @@ def shardkv_step(
         # the committed frontier replaces the schedule tensor as "the
         # controller's view" for clerk visibility and the lag metric
         active_cfg = frontier
-
-        # announcers: submit ANNOUNCE(frontier+1, var) to a random node that
-        # believes it is the leader, once the schedule says the config is
-        # due. A stale minority leader accepts the entry into its log (the
-        # phantom's home until raft rolls it back); only the majority
-        # leader's copy commits.
+    elif kcfg.computed_ctrler:
+        # (kcfg.computed_ctrler) The controller's apply machine IS the 4A
+        # state machine. The committed stream carries FLIP(slot, gid) ops;
+        # the walker applies them IN SLOT ORDER: flip the canonical member
+        # mask (floor: never empty), then run the 4A closed-form rebalance
+        # ONCE PER REPLICA with that replica's tie rotation (tie_rot =
+        # node_id under the planted rotate bug, else 0 everywhere — the
+        # ctrler.py node-apply contract). Replica maps land write-once in
+        # ctrl_maps; replica 0 (rot 0) is canonical and fills cfg_owner[j],
+        # which the rest of the layer (freeze epochs, pull/GC routing,
+        # clerks) keeps reading unchanged. Entries for already-resolved
+        # slots are late duplicates (the losing announcer) — ignored.
+        resolved = jnp.sum(jnp.cumprod((win_var >= 0).astype(I32))) - 1
+        rot_n = jnp.arange(n, dtype=I32) * skn.bug_rotate_tiebreak.astype(I32)
+        g_lane = jnp.arange(g, dtype=I32)
+        slot_lane = jnp.arange(ncfgs, dtype=I32)
+        # Pass 1 — walk the committed window (cheap scalar scan): advance
+        # the cursor, spot THE resolving entry if one is present. At most
+        # ONE slot can resolve per tick: a slot-(j+1) proposal is only
+        # submitted after an announcer OBSERVED the walker-resolved
+        # frontier >= j (can_ann below), so its commit is strictly later
+        # than j's resolution tick — which is what lets the expensive
+        # per-replica rebalance run ONCE per tick (pass 2) instead of
+        # walk_max times (the round-3 sequential-depth-cliff discipline).
+        found = jnp.asarray(False)
+        found_flip = jnp.asarray(0, I32)
+        for _ in range(kcfg.walk_max):
+            canw = ctrl_w_frontier < ctrl.shadow_len
+            posw = _slot(ctrl_w_frontier + 1, cap)
+            in_win = jnp.any(
+                (lane1 == posw) & (csh_abs == ctrl_w_frontier + 1)
+            )
+            ctrl_w_stalled = ctrl_w_stalled | (canw & ~in_win)
+            canw = canw & in_win
+            val = jnp.sum(jnp.where(lane1 == posw, ctrl.shadow_val, 0))
+            is_op = canw & (val > 0) & (val != NOOP_CMD)
+            slot = (val - 1) // g
+            flip = jnp.clip((val - 1) % g, 0, g - 1)
+            applies = (
+                is_op & ~found
+                & (slot == resolved + 1) & (resolved + 1 < ncfgs)
+            )
+            found_flip = jnp.where(applies, flip, found_flip)
+            found = found | applies
+            ctrl_w_frontier = jnp.where(
+                canw, ctrl_w_frontier + 1, ctrl_w_frontier
+            )
+        # Pass 2 — apply the single resolution: flip the canonical member
+        # mask (>=1 floor), run the 4A rebalance once per replica with its
+        # tie rotation, and write the maps (write-once per slot).
+        nm = jnp.where(g_lane == found_flip, ~cmem, cmem)
+        nm = jnp.where(jnp.any(nm), nm, cmem)  # >=1 member floor
+        new_mem = jnp.where(found, nm, cmem)
+        reb = jax.vmap(
+            lambda own, rot: _ctrl_rebalance(
+                g, new_mem, own, rot,
+                jnp.asarray(False), jnp.asarray(False),
+            )
+        )(ctrl_node_owner, rot_n)  # [N, NS]
+        ctrl_node_owner = jnp.where(found, reb, ctrl_node_owner)
+        slot_oh = slot_lane == jnp.clip(resolved + 1, 0, ncfgs - 1)
+        ctrl_maps = jnp.where(
+            slot_oh[None, :, None] & found, reb[:, None, :], ctrl_maps
+        )
+        cfg_owner = jnp.where(
+            slot_oh[:, None] & found, reb[0][None, :], cfg_owner
+        )
+        win_var = jnp.where(slot_oh & found, found_flip, win_var)
+        slot_tick = jnp.where(slot_oh & found, t, slot_tick)
+        cmem = new_mem
+        resolved = jnp.where(found, resolved + 1, resolved)
+        frontier = resolved
+        active_cfg = frontier
+    if kcfg.live_ctrler or kcfg.computed_ctrler:
+        # announcers: submit the slot-(frontier+1) proposal to a random node
+        # that believes it is the leader, once the schedule says the config
+        # is due. A stale minority leader accepts the entry into its log
+        # (the phantom's home until raft rolls it back); only the majority
+        # leader's copy commits. live mode: ANNOUNCE(j, variant); computed
+        # mode: FLIP(j, gid) — truth and phantom carry COMPETING flips, so
+        # the committed order decides config content.
         ka = jax.random.split(jax.random.fold_in(key, _S_ANN), 6)
         jnext = jnp.clip(frontier + 1, 0, ncfgs - 1)
         due = jnp.sum(
@@ -670,6 +870,7 @@ def shardkv_step(
         can_ann = (frontier + 1 < ncfgs) & due
         c_term, c_val, c_len = ctrl.log_term, ctrl.log_val, ctrl.log_len
         me_cn = jnp.arange(n, dtype=I32)
+        jnext_oh = jnp.arange(ncfgs, dtype=I32) == jnext
         for var_bit, p_sub, kd, kt_ in (
             (0, skn.p_announce, ka[0], ka[1]),
             (1, skn.p_phantom, ka[2], ka[3]),
@@ -681,7 +882,11 @@ def shardkv_step(
                 & (c_len - ctrl.base < cap)
                 & (c_len - ctrl.commit < kn.flow_cap)
             )
-            av_ = jnext * 2 + var_bit + 1
+            if kcfg.computed_ctrler:
+                flips = st.flip_a if var_bit == 0 else st.flip_b
+                av_ = jnext * g + jnp.sum(jnp.where(jnext_oh, flips, 0)) + 1
+            else:
+                av_ = jnext * 2 + var_bit + 1
             hit = ok[:, None] & (
                 lane1[None, :] == _slot(c_len + 1, cap)[:, None]
             )
@@ -695,12 +900,30 @@ def shardkv_step(
     snap_cfg, snap_phase = st.snap_cfg, st.snap_phase
     snap_hash, snap_count = st.snap_hash, st.snap_count
     snap_last_seq = st.snap_last_seq
+    node_src, snap_src, w_src = st.node_src, st.snap_src, st.w_src
+
+    # computed_ctrler: replica `src`'s owner map for config `cj` — a
+    # one-hot contraction over the write-once map history (tiny [N*NCFG, NS]
+    # matmul; no dynamic gather). Well-defined for any (src, cj) a CONFIG
+    # entry can carry: groups adopt only walker-resolved slots.
+    if kcfg.computed_ctrler:
+        maps_flat = ctrl_maps.reshape(n * ncfgs, ns)
+        idx_lane = jnp.arange(n * ncfgs, dtype=I32)
+
+        def map_at(src, cj):
+            idx = (
+                jnp.clip(src, 0, n - 1) * ncfgs
+                + jnp.clip(cj, 0, ncfgs - 1)
+            )
+            oh = idx_lane == idx[..., None]
+            return jnp.sum(jnp.where(oh[..., None], maps_flat, 0), axis=-2)
 
     # 1. Crash/restart: live service state resets to the node's own persisted
     #    snapshot; replay from base rebuilds (kv.py pattern).
     fresh = (~pre.alive & s.alive) | ~s.alive  # [G, N]
     applied = jnp.where(fresh, s.base, applied)
     node_cfg = jnp.where(fresh, snap_cfg, node_cfg)
+    node_src = jnp.where(fresh, snap_src, node_src)
     phase = jnp.where(fresh[..., None], snap_phase, phase)
     key_hash = jnp.where(fresh[..., None], snap_hash, key_hash)
     key_count = jnp.where(fresh[..., None], snap_count, key_count)
@@ -720,6 +943,7 @@ def shardkv_step(
     inst = s.snap_installed_src >= 0  # [G, N]
     comp = (s.base != pre.base) & ~inst & s.alive
     snap_cfg = jnp.where(comp, node_cfg, snap_cfg)
+    snap_src = jnp.where(comp, node_src, snap_src)
     snap_phase = jnp.where(comp[..., None], phase, snap_phase)
     snap_hash = jnp.where(comp[..., None], key_hash, snap_hash)
     snap_count = jnp.where(comp[..., None], key_count, snap_count)
@@ -737,11 +961,13 @@ def shardkv_step(
 
     applied = jnp.where(inst, s.base, applied)
     node_cfg = jnp.where(inst, adopt(snap_cfg[..., None])[..., 0], node_cfg)
+    node_src = jnp.where(inst, adopt(snap_src[..., None])[..., 0], node_src)
     phase = jnp.where(inst[..., None], adopt(snap_phase), phase)
     key_hash = jnp.where(inst[..., None], adopt(snap_hash), key_hash)
     key_count = jnp.where(inst[..., None], adopt(snap_count), key_count)
     last_seq = jnp.where(inst[..., None, None], adopt(snap_last_seq), last_seq)
     snap_cfg = jnp.where(inst, node_cfg, snap_cfg)
+    snap_src = jnp.where(inst, node_src, snap_src)
     snap_phase = jnp.where(inst[..., None], phase, snap_phase)
     snap_hash = jnp.where(inst[..., None], key_hash, snap_hash)
     snap_count = jnp.where(inst[..., None], key_count, snap_count)
@@ -762,9 +988,12 @@ def shardkv_step(
     # stale-epoch DELETE — e.g. appended by a replay-lagged leader whose
     # applied view still showed an older freeze — is a no-op instead of
     # destroying a newer frozen copy.
+    # (computed_ctrler: cfg_owner is the CANONICAL computed chain — rows fill
+    # as slots commit, and every consumer below only reads rows <= a config
+    # view that is itself <= the committed frontier)
     away_gs = (
-        (st.cfg_owner[None, :-1] == gids_v[:, None, None])
-        & (st.cfg_owner[None, 1:] != gids_v[:, None, None])
+        (cfg_owner[None, :-1] == gids_v[:, None, None])
+        & (cfg_owner[None, 1:] != gids_v[:, None, None])
     )  # [G, NCFG-1, NS]
     cnum_v = jnp.arange(1, kcfg.n_configs, dtype=I32)[None, :, None]
 
@@ -779,7 +1008,9 @@ def shardkv_step(
         can = s.alive & (applied < s.commit)  # [G, N]
         pos = _slot(applied + 1, cap)
         val = jnp.sum(jnp.where(lane == pos[..., None], s.log_val, 0), axis=-1)
-        kind, client, seq, shard, cfg_c, cfg_i, _var = _unpack(kcfg, val)
+        kind, client, seq, shard, cfg_c, cfg_i, _var = _unpack(
+            kcfg, val, src_lim=n if kcfg.computed_ctrler else 2
+        )
         client = jnp.clip(client, 0, nc - 1)
         sh_oh = sh_lane[None, None, :] == shard[..., None]          # [G,N,NS]
         cl_oh = cl_lane[None, None, :] == client[..., None]          # [G,N,NC]
@@ -823,8 +1054,17 @@ def shardkv_step(
         # shards freeze (unless bug), gained shards start pulling; a shard
         # gained in config 0..  that nobody previously owned starts OWNED.
         is_cfg = can & (kind == _CONFIG) & (cfg_c == node_cfg + 1)
-        # cfg_c is [G,N]; st.cfg_owner is [NCFG, NS] -> result [G,N,NS]
-        new_owner = st.cfg_owner[jnp.clip(cfg_c, 0, kcfg.n_configs - 1)]
+        if kcfg.computed_ctrler:
+            # the entry records WHICH controller replica's computed map the
+            # group adopted; the previous map is the node's own last
+            # adoption (node_src) — both stable pure functions of the
+            # committed controller prefix, so replay reconstructs them
+            new_owner = map_at(_var, cfg_c)          # [G, N, NS]
+            prev_owner = map_at(node_src, node_cfg)  # [G, N, NS]
+        else:
+            # cfg_c is [G,N]; cfg_owner is [NCFG, NS] -> result [G,N,NS]
+            new_owner = cfg_owner[jnp.clip(cfg_c, 0, kcfg.n_configs - 1)]
+            prev_owner = cfg_owner[jnp.clip(cfg_c - 1, 0, kcfg.n_configs - 1)]
         my_g = jnp.arange(g, dtype=I32)[:, None, None]
         # gains only from ABSENT: a leader may not adopt a config that
         # re-gains a shard it still holds FROZEN (the older migration still
@@ -834,7 +1074,6 @@ def shardkv_step(
         # frozen copy and deadlock the older migration against the newer one.
         gains = (new_owner == my_g) & (phase == ABSENT)
         loses = (new_owner != my_g) & (phase == OWNED)
-        prev_owner = st.cfg_owner[jnp.clip(cfg_c - 1, 0, kcfg.n_configs - 1)]
         from_nobody = prev_owner == new_owner  # unchanged owner: no migration
         phase = jnp.where(
             is_cfg[..., None] & gains,
@@ -844,6 +1083,8 @@ def shardkv_step(
             is_cfg[..., None] & loses & ~skn.bug_skip_freeze, FROZEN, phase
         )
         node_cfg = jnp.where(is_cfg, cfg_c, node_cfg)
+        if kcfg.computed_ctrler:
+            node_src = jnp.where(is_cfg, jnp.clip(_var, 0, n - 1), node_src)
 
         # INSTALL(s, c): adopt the staged payload (group-level staging models
         # the payload riding the entry); only meaningful while PULLING, and
@@ -908,7 +1149,9 @@ def shardkv_step(
             jnp.where(lane_g == posw[:, None], s.shadow_val, 0), axis=1
         )
         canw = canw & in_win
-        kind, client, seq, shard, cfg_c, cfg_i, var_c = _unpack(kcfg, val)
+        kind, client, seq, shard, cfg_c, cfg_i, var_c = _unpack(
+            kcfg, val, src_lim=n if kcfg.computed_ctrler else 2
+        )
         client = jnp.clip(client, 0, nc - 1)
         sh_oh = sh_lane[None, :] == shard[:, None]   # [G, NS]
         cl_oh = cl_lane[None, :] == client[:, None]  # [G, NC]
@@ -973,8 +1216,23 @@ def shardkv_step(
         )
 
         is_cfg = canw & (kind == _CONFIG) & (cfg_c == w_cfg + 1)
-        new_owner = st.cfg_owner[jnp.clip(cfg_c, 0, kcfg.n_configs - 1)]  # [G,NS]
-        prev_owner = st.cfg_owner[jnp.clip(cfg_c - 1, 0, kcfg.n_configs - 1)]
+        if kcfg.computed_ctrler:
+            new_owner = map_at(var_c, cfg_c)     # [G, NS]
+            prev_owner = map_at(w_src, w_cfg)    # [G, NS]
+            # Composite 4A->4B oracle: the adopted map must BE the canonical
+            # controller decision (replica 0's rot-0 chain). Under the
+            # planted rotate bug a group that adopted a rotated replica's
+            # map acted on a config the canonical controller never produced
+            # — the HashMap-iteration divergence propagating into 4B.
+            canon = cfg_owner[jnp.clip(cfg_c, 0, kcfg.n_configs - 1)]
+            stale_map = is_cfg & jnp.any(new_owner != canon, axis=-1)
+            viol |= jnp.where(
+                jnp.any(stale_map), VIOLATION_SHARD_CTRL_STALE, 0
+            )
+            w_src = jnp.where(is_cfg, jnp.clip(var_c, 0, n - 1), w_src)
+        else:
+            new_owner = cfg_owner[jnp.clip(cfg_c, 0, kcfg.n_configs - 1)]  # [G,NS]
+            prev_owner = cfg_owner[jnp.clip(cfg_c - 1, 0, kcfg.n_configs - 1)]
         gains = (new_owner == my_gv[:, None]) & (w_phase == ABSENT)
         loses = (new_owner != my_gv[:, None]) & (w_phase == OWNED)
         from_nobody = prev_owner == new_owner
@@ -1168,7 +1426,7 @@ def shardkv_step(
     # GC-confirm poll of the gain-config owner) must land first. No circular
     # wait: the dest's install only needs the frozen copy to exist, not our
     # config progress.
-    next_owner_l = st.cfg_owner[
+    next_owner_l = cfg_owner[
         jnp.clip(l_cfg + 1, 0, kcfg.n_configs - 1)
     ]  # [G, NS]
     regain_blocked = jnp.any(
@@ -1184,7 +1442,7 @@ def shardkv_step(
     cq_req_j = st.cq_req_j
     cq_rsp_t, cq_rsp_j = st.cq_rsp_t, st.cq_rsp_j
     cq_rsp_found, cq_rsp_var = st.cq_rsp_found, st.cq_rsp_var
-    if kcfg.live_ctrler:
+    if kcfg.live_ctrler or kcfg.computed_ctrler:
         # Query protocol to the live controller: one outstanding Query per
         # group, request and response each paying a lossy delayed hop. The
         # group adopts config j = l_cfg+1 when a response says the announce
@@ -1220,36 +1478,44 @@ def shardkv_step(
         )
         wv_req = jnp.sum(jnp.where(j_ohg, win_var[None, :], 0), axis=1)
         found_ok = (jreq <= cnt_at) & (wv_req >= 0)
-        labs = _lane_abs(ctrl.base, cap)                 # [N, cap]
-        lval = ctrl.log_val
-        is_ann_l = (
-            (lval > 0) & (lval != NOOP_CMD)
-            & (labs <= ctrl.log_len[:, None])
-        )
-        lj = (lval - 1) // 2
-        lv = (lval - 1) % 2
-        m = (
-            node_oh[:, :, None] & is_ann_l[None, :, :]
-            & (lj[None, :, :] == jreq[:, None, None])
-        )  # [G, N, cap]
-        has_tail = jnp.any(m, axis=(1, 2))
-        amin = jnp.min(
-            jnp.where(m, labs[None, :, :], _BIG), axis=(1, 2)
-        )  # the node's FIRST log occurrence of announce j
-        var_tail = jnp.sum(
-            jnp.where(
-                m & (labs[None, :, :] == amin[:, None, None]),
-                lv[None, :, :], 0,
-            ),
-            axis=(1, 2),
-        )
-        found_rep = jnp.where(
-            skn.bug_stale_ctrler_read, has_tail | found_ok, found_ok
-        )
-        var_rep = jnp.where(
-            skn.bug_stale_ctrler_read & has_tail,
-            var_tail, jnp.maximum(wv_req, 0),
-        )
+        if kcfg.computed_ctrler:
+            # the answer IS the replica: the group adopts the map the
+            # queried replica computed (canonical when the rotate bug is
+            # off; that replica's rotated chain when it is on — the 4A
+            # divergence reaching a 4B group through a legal read)
+            found_rep = found_ok
+            var_rep = jnp.clip(cq_req_node, 0, n - 1)
+        else:
+            labs = _lane_abs(ctrl.base, cap)                 # [N, cap]
+            lval = ctrl.log_val
+            is_ann_l = (
+                (lval > 0) & (lval != NOOP_CMD)
+                & (labs <= ctrl.log_len[:, None])
+            )
+            lj = (lval - 1) // 2
+            lv = (lval - 1) % 2
+            m = (
+                node_oh[:, :, None] & is_ann_l[None, :, :]
+                & (lj[None, :, :] == jreq[:, None, None])
+            )  # [G, N, cap]
+            has_tail = jnp.any(m, axis=(1, 2))
+            amin = jnp.min(
+                jnp.where(m, labs[None, :, :], _BIG), axis=(1, 2)
+            )  # the node's FIRST log occurrence of announce j
+            var_tail = jnp.sum(
+                jnp.where(
+                    m & (labs[None, :, :] == amin[:, None, None]),
+                    lv[None, :, :], 0,
+                ),
+                axis=(1, 2),
+            )
+            found_rep = jnp.where(
+                skn.bug_stale_ctrler_read, has_tail | found_ok, found_ok
+            )
+            var_rep = jnp.where(
+                skn.bug_stale_ctrler_read & has_tail,
+                var_tail, jnp.maximum(wv_req, 0),
+            )
         alive_at = jnp.any(node_oh & ctrl.alive[None, :], axis=1)
         rdelay, rlost = _net_pair(knet[4], (g,))
         send_rsp2 = req_arr & alive_at & ~rlost
@@ -1273,7 +1539,7 @@ def shardkv_step(
     # (b) pull requests for PULLING shards -> previous owner.
     want_pull = (l_phase == PULLING) & lead_any[:, None]  # [G(dst), NS]
     pull_draw = jax.random.bernoulli(kp[1], skn.p_pull, (g, ns))
-    prev_owner_l = st.cfg_owner[jnp.clip(l_cfg - 1, 0, kcfg.n_configs - 1)]  # [G, NS]
+    prev_owner_l = cfg_owner[jnp.clip(l_cfg - 1, 0, kcfg.n_configs - 1)]  # [G, NS]
     do_pull = want_pull & pull_draw
     tgt_oh = prev_owner_l[:, None, :] == my_gv[None, :, None]  # [dst, src, NS]
     delay2, lost2 = _net_pair(knet[2], (g, g, ns))
@@ -1291,7 +1557,7 @@ def shardkv_step(
         jnp.where(
             jnp.arange(kcfg.n_configs, dtype=I32)[None, :, None]
             == freeze_cfg[:, None, :],
-            st.cfg_owner[None, :, :], 0,
+            cfg_owner[None, :, :], 0,
         ),
         axis=1,
     )  # [G, NS]: owner at the holder's freeze config
@@ -1335,7 +1601,10 @@ def shardkv_step(
     clerk_acked = jnp.where(newly, st.clerk_seq, st.clerk_acked)
     clerk_out = st.clerk_out & ~newly
     gets_done = st.gets_done + done_get.astype(I32)
-    learn = jax.random.bernoulli(kc[0], skn.p_cfg_learn, (nc,))
+    # WrongGroup re-query (client.rs:16-25): a marked clerk re-learns NOW
+    learn = jax.random.bernoulli(kc[0], skn.p_cfg_learn, (nc,)) | (
+        skn.requery_wrong_group & st.clerk_wrong
+    )
     clerk_cfg = jnp.where(
         learn, active_cfg, st.clerk_cfg
     )
@@ -1392,8 +1661,12 @@ def shardkv_step(
         return log_term, log_val, log_len
 
     # CONFIG advance at the (single chosen) leader node; the entry records
-    # which announce variant the group adopted (live-ctrler mode).
-    cfg_val = _pack_config(node_cfg + 1, adopt_var[:, None])  # [G, N]
+    # which announce variant (live-ctrler) or controller replica
+    # (computed-ctrler) the group adopted.
+    cfg_val = _pack_config(
+        node_cfg + 1, adopt_var[:, None],
+        src_lim=n if kcfg.computed_ctrler else 2,
+    )  # [G, N]
     log_term, log_val, log_len = append_at(
         ln_oh & can_advance[:, None] & is_lead, cfg_val,
         log_term, log_val, log_len,
@@ -1420,7 +1693,7 @@ def shardkv_step(
     # it has — a FROZEN surrendered copy (missing every append the new owner
     # accepted since the freeze) or nothing at all after GC. The interval
     # oracle must flag any observation below the invoke-time truth.
-    owner_of = st.cfg_owner[jnp.clip(clerk_cfg, 0, kcfg.n_configs - 1)]  # [NC, NS]
+    owner_of = cfg_owner[jnp.clip(clerk_cfg, 0, kcfg.n_configs - 1)]  # [NC, NS]
     grp_c = jnp.sum(jnp.where(sh_oh_new, owner_of, 0), axis=1)  # [NC]
     sel4 = (
         (gids_v[None, :, None, None] == grp_c[:, None, None, None])
@@ -1444,6 +1717,15 @@ def shardkv_step(
     clerk_out = clerk_out & ~served
     gets_done = gets_done + served.astype(I32)
     retry = retry & ~served
+    # WrongGroup detection (client.rs:16-25): this submit reached an alive
+    # LEADER of the believed owner group and the shard is not serving there
+    # — the clerk is marked and (under requery_wrong_group) re-learns the
+    # config next tick instead of waiting for its p_cfg_learn draw.
+    tgt_gn = jnp.any(sel4, axis=-1)  # [NC, G, N]
+    lead_at_c = jnp.any(tgt_gn & is_lead[None], axis=(1, 2))
+    clerk_wrong = jnp.where(
+        retry, lead_at_c & (ph_at != OWNED), st.clerk_wrong & ~learn
+    )
 
     # Client ops at the believed owner's targeted node (leader-gated; a wrong
     # or stale guess commits nothing or a rejected entry — the clerk retries).
@@ -1471,9 +1753,12 @@ def shardkv_step(
     )
     return ShardKvState(
         rafts=rafts,
-        cfg_tick=st.cfg_tick, cfg_owner=st.cfg_owner,
+        cfg_tick=st.cfg_tick, cfg_owner=cfg_owner,
         ctrl=ctrl, ctrl_w_frontier=ctrl_w_frontier,
         ctrl_w_stalled=ctrl_w_stalled, win_var=win_var,
+        flip_a=st.flip_a, flip_b=st.flip_b, slot_tick=slot_tick,
+        cmem=cmem, ctrl_node_owner=ctrl_node_owner, ctrl_maps=ctrl_maps,
+        node_src=node_src, snap_src=snap_src, w_src=w_src,
         cq_req_t=cq_req_t, cq_req_node=cq_req_node, cq_req_j=cq_req_j,
         cq_rsp_t=cq_rsp_t, cq_rsp_j=cq_rsp_j,
         cq_rsp_found=cq_rsp_found, cq_rsp_var=cq_rsp_var,
@@ -1492,7 +1777,7 @@ def shardkv_step(
         gcq_rsp_t=gcq_rsp_t, gcq_rsp_cfg=gcq_rsp_cfg,
         clerk_seq=clerk_seq, clerk_out=clerk_out,
         clerk_shard=clerk_shard, clerk_kind=clerk_kind, clerk_cfg=clerk_cfg,
-        clerk_acked=clerk_acked,
+        clerk_wrong=clerk_wrong, clerk_acked=clerk_acked,
         clerk_get_lo=clerk_get_lo, clerk_get_obs=clerk_get_obs,
         gets_done=gets_done,
         w_frontier=w_frontier, w_cfg=w_cfg, w_phase=w_phase,
@@ -1618,7 +1903,8 @@ def _validate_shardkv_knobs(skn) -> None:
         raise ValueError(f"cfg_interval must be >= 2: {k.cfg_interval}")
     validate_bool_bugs(
         k, ("bug_skip_freeze", "bug_drop_dup_table", "bug_serve_frozen",
-            "bug_stale_ctrler_read"),
+            "bug_stale_ctrler_read", "bug_rotate_tiebreak",
+            "requery_wrong_group"),
         "shardkv",
     )
 
@@ -1644,6 +1930,13 @@ def make_shardkv_sweep_fn(
     _validate_knobs(knobs)
     validate_service_raft_knobs(knobs)
     _validate_shardkv_knobs(sknobs)
+    if not kcfg.computed_ctrler and bool(
+        np.asarray(sknobs.bug_rotate_tiebreak).any()
+    ):
+        raise ValueError(
+            "bug_rotate_tiebreak (sweep knob) needs kcfg.computed_ctrler "
+            "— without the computed controller it would silently do nothing"
+        )
     prog = _shardkv_program(cfg.static_key(), kcfg.static_key(), n_clusters,
                             mesh, per_cluster_knobs=True)
     kn = knobs.broadcast(n_clusters)

@@ -12,6 +12,7 @@ override re-asserts the jax config after import instead of relying on the
 env var alone.
 """
 
+import contextlib
 import os
 
 _ON_TPU = os.environ.get("MADRAFT_TPU_TESTS") == "1"
@@ -43,3 +44,27 @@ jax.config.update(
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# CAUTION: XLA's executable.serialize() SEGFAULTS on this container for the
+# largest mesh-sharded shardkv executable (jax compilation_cache
+# put_executable_and_time, reproduced 4x in round 5 — localized by the
+# faulthandler trace, NOT a madtpu bug). Tests that compile that program
+# wrap themselves in no_persistent_cache() below; everything else caches.
+
+
+@contextlib.contextmanager
+def no_persistent_cache():
+    """Temporarily disable persistent-cache WRITES (see CAUTION).
+
+    Setting jax_compilation_cache_dir to None here does NOT work: the cache
+    object initializes at most once per process (compilation_cache._get_cache)
+    and later config changes are ignored. The min-compile-time threshold IS
+    read live by compiler._cache_write, so an absurd floor skips every write.
+    """
+    old = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1e9)
+    try:
+        yield
+    finally:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", old
+        )

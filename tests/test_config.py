@@ -64,7 +64,7 @@ def test_shardkvconfig_fields_all_reach_the_program():
     from madraft_tpu.tpusim.shardkv import ShardKvConfig, ShardKvKnobs
 
     static = {"n_groups", "n_shards", "n_clients", "n_configs",
-              "apply_max", "walk_max", "live_ctrler"}
+              "apply_max", "walk_max", "live_ctrler", "computed_ctrler"}
     knob_names = set(ShardKvKnobs._fields)
     for f in dataclasses.fields(ShardKvConfig):
         if f.name in static:

@@ -76,3 +76,20 @@ def test_watchdog_names_the_wedged_test():
     )
     assert proc.returncode != 0
     assert "[WDOG ] test wdog_selftest_wedge exceeded 2s VIRTUAL" in proc.stderr
+
+
+def test_sigalrm_backstop_names_cpu_bound_hang():
+    """A CPU-bound hang never returns to the event loop, so only the runner's
+    SIGALRM backstop can catch it — and it must still name the test."""
+    _ensure_built()
+    proc = subprocess.run(
+        [str(BINARY), "wdog_selftest_spin"],
+        env={
+            "MADTPU_TEST_SEED": SEED,
+            "MADTPU_TEST_REAL_CAP": "1",  # alarm fires at ~3s
+            "PATH": "/usr/bin:/bin",
+        },
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode != 0
+    assert "[WDOG ] test wdog_selftest_spin hit the SIGALRM" in proc.stderr
