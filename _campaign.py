@@ -162,5 +162,32 @@ for g, nodes in [(2, 3), (3, 5)]:
     check(f"  live walker never stalls g={g}",
           not rr.ctrl_walker_stalled.any(), "ctrl walker fell behind")
 
+# 6. shardkv with the COMPUTED controller (the 4A∘4B composition): config
+# content computed per replica from committed membership flips, under the
+# same storm, shape-varied. Safety + slot resolution + the composite bug.
+for g, nodes in [(2, 3), (3, 5)]:
+    raft = SimConfig(n_nodes=nodes, p_client_cmd=0.0, compact_at_commit=False,
+                     log_cap=64, compact_every=16, loss_prob=0.1,
+                     p_crash=0.01, p_restart=0.2, max_dead=1,
+                     p_repartition=0.03, p_heal=0.08)
+    sk = ShardKvConfig(n_groups=g, n_configs=8, cfg_interval=45,
+                       p_get=0.3, p_put=0.2, computed_ctrler=True,
+                       p_phantom=0.4)
+    rr = shardkv_fuzz(raft, sk, seed=93, n_clusters=10, n_ticks=900)
+    check(f"shardkv computed-ctrler g={g} n={nodes}", rr.n_violating == 0,
+          f"viol={rr.n_violating} ann={rr.ann_resolved.min()}")
+    check(f"  computed slots resolve g={g}", (rr.ann_resolved >= 3).all(),
+          f"slots={np.sort(rr.ann_resolved).tolist()}")
+from madraft_tpu.tpusim.shardkv import VIOLATION_SHARD_CTRL_STALE
+
+raft3 = SimConfig(n_nodes=3, p_client_cmd=0.0, compact_at_commit=False,
+                  log_cap=64, compact_every=16, loss_prob=0.05)
+skb = ShardKvConfig(computed_ctrler=True, bug_rotate_tiebreak=True,
+                    cfg_interval=40)
+rr = shardkv_fuzz(raft3, skb, seed=7, n_clusters=12, n_ticks=512)
+check("shardkv composite rotate bug caught",
+      ((rr.violations & VIOLATION_SHARD_CTRL_STALE) != 0).any(),
+      "the 4A rotate bug never propagated to a 4B violation")
+
 print("CAMPAIGN DONE", "FAILURES:" if fails else "all clean", fails)
 raise SystemExit(1 if fails else 0)
