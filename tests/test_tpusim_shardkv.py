@@ -503,3 +503,33 @@ def test_shardkv_wrong_group_requery_helps_and_stays_safe():
         f"re-query must not cost liveness: {r_on.acked_ops.sum()} vs "
         f"{r_off.acked_ops.sum()}"
     )
+
+
+def test_shardkv_computed_ctrler_long_chain_gc_completes():
+    """The composed mode across a LONG computed chain: 16 configs computed
+    from committed flips under a crash/loss storm, with the same
+    GC-completion obligations as the schedule-tensor mode (the round-3
+    soak-found-leak test, mirrored here for computed_ctrler) — every
+    deployment near the end of the chain, installs ~= deletes, (almost) no
+    frozen copies left, zero violations."""
+    storm = RAFT.replace(p_crash=0.01, p_restart=0.2, max_dead=1,
+                         loss_prob=0.1)
+    kcfg = SKV.replace(computed_ctrler=True, n_configs=16, cfg_interval=70)
+    rep = shardkv_fuzz(storm, kcfg, seed=424, n_clusters=12, n_ticks=2400)
+    assert rep.n_violating == 0, (
+        f"violations {rep.violations[rep.violating_clusters()[:8]]} raft "
+        f"{rep.raft_violations[rep.violating_clusters()[:8]]}"
+    )
+    assert (rep.ann_resolved >= kcfg.n_configs - 3).all(), (
+        f"computed chain stalled: slots {np.sort(rep.ann_resolved)}"
+    )
+    assert (rep.final_cfg >= kcfg.n_configs - 3).all(), (
+        f"adoption stalled: final configs {np.sort(rep.final_cfg)}"
+    )
+    lag = rep.installs - rep.deletes
+    assert (lag >= 0).all() and (lag <= kcfg.n_shards).all(), (
+        f"GC lag per deployment: {lag}"
+    )
+    assert rep.frozen_left.sum() <= kcfg.n_shards, (
+        f"frozen copies leaked: {rep.frozen_left.sum()}"
+    )
