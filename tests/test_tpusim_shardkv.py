@@ -298,6 +298,18 @@ def test_shardkv_sharded_over_mesh():
     np.testing.assert_array_equal(rep_sharded.acked_ops, rep_local.acked_ops)
     np.testing.assert_array_equal(rep_sharded.installs, rep_local.installs)
 
+    # the computed-controller program must be sharding-invariant too (its
+    # walker/maps state rides the same per-deployment axis)
+    ckcfg = SKV.replace(computed_ctrler=True, cfg_interval=40)
+    cfn = make_shardkv_fuzz_fn(RAFT, ckcfg, n_clusters=16, n_ticks=128,
+                               mesh=mesh)
+    crep_sharded = shardkv_report(
+        jax.block_until_ready(cfn(jnp.asarray(4, jnp.uint32)))
+    )
+    crep_local = shardkv_fuzz(RAFT, ckcfg, seed=4, n_clusters=16, n_ticks=128)
+    for a, b in zip(crep_sharded, crep_local):
+        np.testing.assert_array_equal(a, b)
+
 
 def test_shardkv_with_puts_clean():
     """The full reference op set Op::{Get,Put,Append} across migration: Puts
