@@ -154,6 +154,9 @@ def main() -> None:
     # leaves no artifact at all. SOAK_PLATFORM=cpu skips the probe (CI smoke).
     from madraft_tpu._platform import apply_platform, init_backend_with_retry
 
+    # a soak exists to leave artifacts — opt in to TUNNEL_STATUS.jsonl
+    # probe recording (library/test imports stay silent by default)
+    os.environ.setdefault("MADTPU_TUNNEL_LOG", "1")
     plat = apply_platform(os.environ.get("SOAK_PLATFORM"))
     if plat != "cpu":
         ok, detail = init_backend_with_retry(plat, attempts=6)

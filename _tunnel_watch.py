@@ -10,8 +10,13 @@ subprocess with a hard timeout, and the waiter itself just sleeps.
 Usage: nohup python _tunnel_watch.py > /tmp/tunnel_watch.log 2>&1 &
 """
 
+import os
 import sys
 import time
+
+# recording probe outcomes IS this script's purpose — opt in to the
+# TUNNEL_STATUS.jsonl artifact (library/test imports stay silent by default)
+os.environ.setdefault("MADTPU_TUNNEL_LOG", "1")
 
 from madraft_tpu import _platform
 

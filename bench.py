@@ -225,6 +225,9 @@ def main() -> None:
 
     from madraft_tpu._platform import apply_platform, init_backend_with_retry
 
+    # bench runs exist to leave artifacts — opt in to TUNNEL_STATUS.jsonl
+    # probe recording (library/test imports stay silent by default)
+    os.environ.setdefault("MADTPU_TUNNEL_LOG", "1")
     plat = apply_platform(os.environ.get("MADTPU_BENCH_PLATFORM"))
     degraded = None
     if plat != "cpu":

@@ -51,6 +51,11 @@ fi
 
 echo "== [4/5] Python/TPU-sim suite (8-device virtual CPU mesh)"
 python -m pytest tests/ --ignore tests/test_cpp_suite.py -q
+# durability-storm smoke: the correct algorithm under TOTAL un-fsynced
+# suffix loss (the madsim `fs` axis; --profile durability) must report
+# zero violations — the CLI exits 1 on any violating cluster
+MADTPU_PLATFORM=cpu python -m madraft_tpu fuzz --profile durability \
+  --clusters 64 --ticks 300 --seed 12345
 
 echo "== [5/5] bench smoke (1024 clusters x 128 ticks)"
 # prefer the attached accelerator; fall back to CPU if it is absent or hung

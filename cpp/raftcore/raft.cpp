@@ -113,7 +113,12 @@ RequestVoteReply Raft::handle_request_vote(const RequestVoteArgs& a) {
       reset_election_deadline();
     }
   }
-  if (term_ != term0 || voted_for_ != voted0)
+  // planted bug (config.py RAFT_BUGS): reply from VOLATILE state — the
+  // persist-before-reply fsync is skipped, so a kill/restore rolls the
+  // vote (and term) back to whatever the last unrelated persist() wrote
+  // and the voter can re-grant the term. Mirrors the TPU backend's
+  // ack_before_fsync handler-sync strip (step.py).
+  if ((term_ != term0 || voted_for_ != voted0) && !bug("ack_before_fsync"))
     persist();  // before the reply leaves the node (raft.rs:224-233)
   return {term_, grant};
 }
@@ -127,6 +132,11 @@ AppendEntriesReply Raft::handle_append_entries(const AppendEntriesArgs& a) {
   leader_hint_ = (int)a.leader;
   reset_election_deadline();
 
+  // planted bug (config.py RAFT_BUGS): every persist in this handler is
+  // skipped — the follower acks appended entries from volatile state, so a
+  // kill/restore rolls its log back past entries a leader already
+  // commit-counted. Mirrors the TPU ack_before_fsync (step.py).
+  const bool ack_bug = bug("ack_before_fsync");
   uint64_t prev_index = a.prev_index;
   size_t skip = 0;  // entries already covered by our snapshot
   if (prev_index < snap_last_index_) {
@@ -136,7 +146,7 @@ AppendEntriesReply Raft::handle_append_entries(const AppendEntriesArgs& a) {
     prev_index = snap_last_index_;
   }
   if (prev_index > last_index()) {
-    if (term_ != term0) persist();
+    if (term_ != term0 && !ack_bug) persist();
     return {term_, false, last_index() + 1};
   }
   if (term_at(prev_index) != a.prev_term && prev_index > snap_last_index_) {
@@ -144,7 +154,7 @@ AppendEntriesReply Raft::handle_append_entries(const AppendEntriesArgs& a) {
     uint64_t ct = term_at(prev_index);
     uint64_t first = prev_index;
     while (first - 1 > snap_last_index_ && term_at(first - 1) == ct) first--;
-    if (term_ != term0) persist();
+    if (term_ != term0 && !ack_bug) persist();
     return {term_, false, first};
   }
   // append, truncating at the first conflict (never truncate on a match —
@@ -169,7 +179,7 @@ AppendEntriesReply Raft::handle_append_entries(const AppendEntriesArgs& a) {
     commit_ = std::min(a.leader_commit, std::max(last_new, commit_));
     commit_ = std::min(commit_, last_index());
   }
-  if (term_ != term0 || log_dirty) persist();
+  if ((term_ != term0 || log_dirty) && !ack_bug) persist();
   apply_committed();
   return {term_, true, last_new};
 }

@@ -15,7 +15,8 @@ namespace madtpu_tools {
 // make a clean replay read as "TPU false positive".
 inline bool is_known_raft_bug(const std::string& name) {
   return name == "commit_any_term" || name == "grant_any_vote" ||
-         name == "forget_voted_for" || name == "no_truncate";
+         name == "forget_voted_for" || name == "no_truncate" ||
+         name == "ack_before_fsync";
 }
 
 struct EnvGuard {

@@ -82,6 +82,12 @@ def _sim_config(args):
         cfg = cfg.replace(majority_override=args.majority_override)
     if args.bug:
         cfg = cfg.replace(bug=args.bug)
+    # durability-axis overrides compose with any profile (a storm profile
+    # plus --lose-unsynced turns its crashes into power-loss crashes)
+    if args.fsync_every:
+        cfg = cfg.replace(fsync_every=args.fsync_every)
+    if args.lose_unsynced >= 0:
+        cfg = cfg.replace(p_lose_unsynced=args.lose_unsynced)
     return cfg
 
 
@@ -413,12 +419,22 @@ def main(argv=None) -> int:
                         help="full fault storm (loss+crash+partitions)")
         sp.add_argument("--majority-override", type=int, default=0,
                         help="deliberately broken quorum (oracle demo)")
+        sp.add_argument("--fsync-every", type=int, default=0,
+                        help="background fsync cadence in ticks (the lossy-"
+                             "persistence axis; 0 = keep the profile/"
+                             "default, 1 = fsync every tick = the perfect-"
+                             "persistence model)")
+        sp.add_argument("--lose-unsynced", type=float, default=-1.0,
+                        help="probability a crash drops the un-fsynced "
+                             "suffix (rolls log/term/vote back to the fsync "
+                             "watermark; negative = keep profile/default)")
         sp.add_argument("--bug", default="",
                         help="raft-layer planted bug (config.py RAFT_BUGS: "
                              "commit_any_term | grant_any_vote | "
-                             "forget_voted_for | no_truncate)")
+                             "forget_voted_for | no_truncate | "
+                             "ack_before_fsync)")
         sp.add_argument("--profile", default="",
-                        choices=["", "storm", "fig8", "revote"],
+                        choices=["", "storm", "fig8", "revote", "durability"],
                         help="tuned fault-storm preset (overrides --nodes "
                              "and --storm); the scale each bug "
                              "was demonstrated at: --profile fig8 --bug "
@@ -426,7 +442,11 @@ def main(argv=None) -> int:
                              "--profile revote --bug forget_voted_for "
                              "--clusters 2048 --ticks 1000; --profile storm "
                              "--bug grant_any_vote|no_truncate "
-                             "--clusters 256 --ticks 600")
+                             "--clusters 256 --ticks 600; --profile "
+                             "durability --bug ack_before_fsync "
+                             "--clusters 256 --ticks 600 (crash storm with "
+                             "fsync_every=8, p_lose_unsynced=1.0 — the "
+                             "lossy-persistence axis)")
 
     def fuzz_common(sp, clusters):
         common(sp, clusters)

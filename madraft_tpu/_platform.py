@@ -39,9 +39,14 @@ _PROBE_SNIPPET = (
     "print('MADTPU_PROBE_OK', d[0])\n"
 )
 
-# Every probe outcome is appended here (round-4 verdict, weak #6: outage
-# claims must be checkable from an artifact, not narrative). One JSON line
-# per probe: {ts, plat, ok, latency_s, detail}. Committed with the repo.
+# Probe outcomes are appended here when MADTPU_TUNNEL_LOG is set (round-4
+# verdict, weak #6: outage claims must be checkable from an artifact, not
+# narrative). One JSON line per probe: {ts, plat, ok, latency_s, detail}.
+# OPT-IN (ADVICE round-5 finding #1): a library import, test run, or an
+# installed copy must not silently append next to the package — set
+# MADTPU_TUNNEL_LOG=1 to log to the repo-root default, or to a path to log
+# there. Driver scripts that exist to produce artifacts (_soak.py etc.)
+# export it themselves.
 _STATUS_LOG = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "TUNNEL_STATUS.jsonl",
@@ -49,6 +54,9 @@ _STATUS_LOG = os.path.join(
 
 
 def _record_probe(plat, ok: bool, latency_s: float, detail: str) -> None:
+    dest = os.environ.get("MADTPU_TUNNEL_LOG", "")
+    if not dest or dest == "0":
+        return
     row = {
         "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
             timespec="seconds"
@@ -59,7 +67,7 @@ def _record_probe(plat, ok: bool, latency_s: float, detail: str) -> None:
         "detail": detail,
     }
     try:
-        with open(_STATUS_LOG, "a") as f:
+        with open(_STATUS_LOG if dest == "1" else dest, "a") as f:
             f.write(json.dumps(row) + "\n")
     except OSError:
         pass  # a read-only checkout must not break the probe itself

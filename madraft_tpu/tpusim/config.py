@@ -34,6 +34,7 @@ import jax.numpy as jnp
 # The raft-layer planted-bug library (see SimConfig.bug).
 RAFT_BUGS = (
     "", "commit_any_term", "grant_any_vote", "forget_voted_for", "no_truncate",
+    "ack_before_fsync",
 )
 
 
@@ -64,6 +65,14 @@ class SimConfig:
             )
         if self.bug not in RAFT_BUGS:
             raise ValueError(f"unknown bug {self.bug!r}; known: {RAFT_BUGS}")
+        if self.fsync_every < 1:
+            raise ValueError(
+                f"fsync_every must be >= 1 (ticks), got {self.fsync_every}"
+            )
+        if not 0.0 <= self.p_lose_unsynced <= 1.0:
+            raise ValueError(
+                f"p_lose_unsynced outside [0, 1]: {self.p_lose_unsynced}"
+            )
 
     # Log compaction (the Lab 2D snapshot path, raft.rs:149-168): a node
     # discards its window prefix up to the compaction boundary every
@@ -119,6 +128,23 @@ class SimConfig:
     # (models RaftHandle::start, /root/reference/src/raft/raft.rs:131).
     p_client_cmd: float = 0.2
 
+    # Storage durability model (the madsim `fs` fault axis: crash/restore
+    # with PARTIALLY durable files — see state.py durability notes and the
+    # README fault-model table). Writes become durable when an fsync
+    # boundary passes:
+    #   fsync_every   — background fsync cadence in ticks (per-node
+    #                   staggered). 1 = sync every tick, i.e. perfect
+    #                   persistence — the historic model, and the default.
+    #   p_lose_unsynced — probability a CRASH also loses the un-fsynced
+    #                   suffix: log_len/term/voted_for roll back to the
+    #                   durable watermark (power loss drops the page cache).
+    # The correct algorithm additionally fsyncs before every state-exposing
+    # emission (persist-before-reply, raft.rs:224-233), so it stays safe at
+    # any (fsync_every, p_lose_unsynced); the planted "ack_before_fsync"
+    # bug removes exactly those reply-point syncs.
+    fsync_every: int = 1
+    p_lose_unsynced: float = 0.0
+
     # Deliberate-bug injection for oracle validation (None = correct algorithm).
     # E.g. majority_override=2 on a 5-node cluster lets two leaders win a term,
     # which the election-safety oracle must flag.
@@ -135,6 +161,10 @@ class SimConfig:
     #   "forget_voted_for" - votedFor is not persisted across a crash
     #   "no_truncate"      - follower appends past its end but never
     #                        overwrites/truncates a conflicting suffix
+    #   "ack_before_fsync" - RequestVote/AppendEntries handlers reply from
+    #                        VOLATILE state (skip the persist-before-reply
+    #                        fsync); a crash storm with p_lose_unsynced > 0
+    #                        then un-commits acked entries / re-frees votes
     # Static (trace-time) on purpose: the correct program carries zero
     # bug-branch cost, and a bug selects its own compiled program.
     bug: str = ""
@@ -159,6 +189,8 @@ class SimConfig:
             p_leader_part=jnp.float32(self.p_leader_part),
             p_asym_cut=jnp.float32(self.p_asym_cut),
             p_client_cmd=jnp.float32(self.p_client_cmd),
+            fsync_every=jnp.int32(self.fsync_every),
+            p_lose_unsynced=jnp.float32(self.p_lose_unsynced),
             eto_min=jnp.int32(self.election_timeout_min),
             eto_max=jnp.int32(self.election_timeout_max),
             delay_min=jnp.int32(self.delay_min),
@@ -199,6 +231,8 @@ class Knobs(NamedTuple):
     p_leader_part: jax.Array
     p_asym_cut: jax.Array
     p_client_cmd: jax.Array
+    fsync_every: jax.Array
+    p_lose_unsynced: jax.Array
     eto_min: jax.Array
     eto_max: jax.Array
     delay_min: jax.Array
@@ -255,10 +289,22 @@ def storm_profiles() -> dict:
         n_nodes=7, max_dead=3, p_crash=0.15, p_restart=0.6, delay_max=6,
         election_timeout_min=10, election_timeout_max=20, p_client_cmd=0.1,
     )
+    # The durability storm exercises the storage axis: every crash drops the
+    # un-fsynced suffix (p_lose_unsynced=1.0) and background fsync is slow
+    # (fsync_every=8 >> the 1-3 tick message delays), so an ack_before_fsync
+    # reply is near-certainly volatile when its node crashes. Crashes are
+    # frequent enough (p_crash=0.1, max_dead=2) that a freshly-acked entry's
+    # holder dies inside the fsync window, yet restarts fast (p_restart=0.4)
+    # so commits keep flowing and a later leader can re-mint the lost index.
+    durability = storm.replace(
+        p_crash=0.1, p_restart=0.4, max_dead=2,
+        fsync_every=8, p_lose_unsynced=1.0,
+    )
     return {
         "storm": (storm, 256, 600, ("grant_any_vote", "no_truncate")),
         "fig8": (fig8, 1024, 1000, ("commit_any_term",)),
         "revote": (revote, 2048, 1000, ("forget_voted_for",)),
+        "durability": (durability, 256, 600, ("ack_before_fsync",)),
     }
 
 # Log value of the no-op entry a freshly elected leader appends (step.py win

@@ -80,7 +80,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
-from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
+from madraft_tpu.tpusim.state import (
+    ClusterState,
+    I32,
+    durable_after_append,
+    init_cluster,
+)
 from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 
 # Violation bits (extending config/kv/shardkv's 1..1024).
@@ -788,6 +793,7 @@ def ctrler_step(
         log_term=log_term,
         log_val=log_val,
         log_len=log_len,
+        durable_len=durable_after_append(s, log_len),
         violations=violations,
         first_violation_tick=first_violation_tick,
         compact_floor=applied,

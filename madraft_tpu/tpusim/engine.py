@@ -129,8 +129,14 @@ def _validate_knobs(knobs) -> None:
     k = jax.tree.map(np.asarray, knobs)
     validate_probs(
         k, ("loss_prob", "p_crash", "p_restart", "p_repartition", "p_heal",
-            "p_leader_part", "p_asym_cut", "p_client_cmd"), "raft",
+            "p_leader_part", "p_asym_cut", "p_client_cmd",
+            "p_lose_unsynced"), "raft",
     )
+    if (k.fsync_every < 1).any():
+        raise ValueError(
+            f"fsync_every must be >= 1 tick (1 = sync every tick, the "
+            f"perfect-persistence model): {k.fsync_every}"
+        )
     if (k.eto_max < k.eto_min).any() or (k.eto_min < 1).any():
         raise ValueError(f"election timeout span empty: [{k.eto_min}, {k.eto_max}]")
     if (k.delay_max < k.delay_min).any() or (k.delay_min < 1).any():

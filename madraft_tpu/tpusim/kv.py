@@ -46,7 +46,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
-from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
+from madraft_tpu.tpusim.state import (
+    ClusterState,
+    I32,
+    durable_after_append,
+    init_cluster,
+)
 from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 
 # Additional violation bits (extending config.VIOLATION_*).
@@ -628,7 +633,13 @@ def kv_step(
     lead_node = jnp.argmax(is_lead_n & (s.term == lead_term)).astype(I32)
     hint_ok = is_lead_n.any() & (s.term == lead_term)  # [N] per contacted node
     ring = (me + 1) % n
-    ring = jnp.where(ring == lead_node, (ring + 1) % n, ring)
+    # skip the real leader only when one EXISTS: argmax over all-False is 0,
+    # so an unmasked skip would unconditionally dodge node 0 during
+    # leaderless windows (ADVICE round-5 finding #5) — the bug-mode ring
+    # must stay uniform when there is no leader to hide
+    ring = jnp.where(
+        is_lead_n.any() & (ring == lead_node), (ring + 1) % n, ring
+    )
     hint_n = jnp.where(
         kkn.bug_stale_hint, ring, jnp.where(hint_ok, lead_node, -1)
     )  # [N]
@@ -654,6 +665,9 @@ def kv_step(
         log_term=log_term,
         log_val=log_val,
         log_len=log_len,
+        # keep the durability watermark with the log (persist-at-append)
+        # so a durability sweep over this layer stays safe
+        durable_len=durable_after_append(s, log_len),
         violations=violations,
         first_violation_tick=first_violation_tick,
         # next tick's compaction boundary: never past what we've applied

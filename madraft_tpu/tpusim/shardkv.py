@@ -76,7 +76,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
 from madraft_tpu.tpusim.ctrler import _rebalance as _ctrl_rebalance
-from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
+from madraft_tpu.tpusim.state import (
+    ClusterState,
+    I32,
+    durable_after_append,
+    init_cluster,
+)
 from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 
 # Violation bits (extending config.VIOLATION_* and kv.VIOLATION_*).
@@ -221,6 +226,15 @@ class ShardKvConfig:
             )
         if self.computed_ctrler:
             from madraft_tpu.tpusim.ctrler import N_SHARDS as CTRL_NS
+
+            if self.n_groups < 2:
+                raise ValueError(
+                    f"computed_ctrler needs n_groups >= 2 (got "
+                    f"{self.n_groups}): the phantom's competing flip is "
+                    "'always a DIFFERENT gid' (init flip_b), which "
+                    "degenerates with one group — the announce race would "
+                    "be meaningless"
+                )
 
             if self.n_shards != CTRL_NS:
                 raise ValueError(
@@ -893,7 +907,10 @@ def shardkv_step(
             c_term = jnp.where(hit, ctrl.term[:, None], c_term)
             c_val = jnp.where(hit, av_, c_val)
             c_len = jnp.where(ok, c_len + 1, c_len)
-        ctrl = ctrl._replace(log_term=c_term, log_val=c_val, log_len=c_len)
+        ctrl = ctrl._replace(
+            log_term=c_term, log_val=c_val, log_len=c_len,
+            durable_len=durable_after_append(ctrl, c_len),
+        )
 
     applied, node_cfg, phase = st.applied, st.node_cfg, st.phase
     key_hash, key_count, last_seq = st.key_hash, st.key_count, st.last_seq
@@ -1749,6 +1766,7 @@ def shardkv_step(
 
     rafts = s._replace(
         log_term=log_term, log_val=log_val, log_len=log_len,
+        durable_len=durable_after_append(s, log_len),
         compact_floor=applied,
     )
     return ShardKvState(

@@ -10,6 +10,17 @@ Design notes (vs the reference, SURVEY.md §2.6/§7):
   persist-before-send ordering the reference gets from fsync-before-reply
   (/root/reference/src/raft/raft.rs:224-233). Crash keeps these arrays; restart only
   resets volatile fields (role, timers, votes, commit, next/match).
+- DURABILITY is modeled separately from the arrays (the madsim ``fs`` axis:
+  crash/restore with partially durable files): ``durable_len`` plus the
+  ``durable_term``/``durable_voted_for`` shadows are the per-node fsync
+  watermark — what has actually reached disk. The correct algorithm fsyncs
+  before any state-exposing emission (reply/broadcast/append-at-leader,
+  step.py) and every ``fsync_every`` ticks in the background; a crash with
+  ``p_lose_unsynced`` rolls term/voted_for/log_len back to the watermark
+  (the un-fsynced suffix is the page cache lost at power-off). Compaction
+  and install-snapshot persist in the reference (raft.rs snapshot()/
+  cond_install_snapshot), so ``base``/``snap_term``/``prefix_hash`` are
+  durable by construction and need no shadows.
 - The network is modeled like madsim's per-message loss/latency draws
   (/root/reference/src/raft/tester.rs:127-137): each directed (dst, src) pair has one
   slot per message type with a delivery tick; overwriting an undelivered slot models
@@ -62,6 +73,11 @@ class ClusterState(NamedTuple):
     #                            see divergence on entries older than the
     #                            window (step.py prefix-divergence check)
     commit: jax.Array          # i32 [N] committed count, absolute (volatile)
+    # --- fsync watermark (what has reached disk; see module docstring) ---
+    durable_len: jax.Array       # i32 [N] highest fsynced log index (absolute);
+    #                              invariants: base <= durable_len <= log_len
+    durable_term: jax.Array      # i32 [N] fsynced shadow of `term`
+    durable_voted_for: jax.Array  # i32 [N] fsynced shadow of `voted_for`
     compact_floor: jax.Array   # i32 [N] service-layer cap on the compaction
     #                            boundary (= its apply cursor); unused when
     #                            cfg.compact_at_commit
@@ -125,6 +141,15 @@ class ClusterState(NamedTuple):
     snap_install_count: jax.Array  # i32 scalar: snapshot installs (2D metric)
 
 
+def durable_after_append(s: ClusterState, new_len: jax.Array) -> jax.Array:
+    """Fsync watermark after a service-layer submit batch: submits model
+    RaftHandle::start -> persist-at-append (raft.rs:311-313 — the leader's
+    own log is commit-counted, so it must be durable), so the watermark
+    follows the log where it grew. The single source of the rule for every
+    service layer's submit path (kv/ctrler/shardkv)."""
+    return jnp.where(new_len > s.log_len, new_len, s.durable_len)
+
+
 def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
     """Fresh cluster at tick 0 with randomized election timers (raft.rs:260-263).
 
@@ -154,6 +179,9 @@ def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
         snap_term=zn,
         prefix_hash=zn,
         commit=zn,
+        durable_len=zn,
+        durable_term=zn,
+        durable_voted_for=jnp.full((n,), -1, I32),
         compact_floor=zn,
         votes=jnp.zeros((n, n), BOOL),
         next_idx=jnp.ones((n, n), I32),

@@ -8,9 +8,15 @@ partitions, kill/restart with persistent state, message counting.
 
 Phase order within a tick (this ordering gives persist-before-send for free — all
 sends are computed from post-update persistent arrays, mirroring the reference's
-"persist after RPC handlers mutate state" rule at raft.rs:224-233):
+"persist after RPC handlers mutate state" rule at raft.rs:224-233 — ONLY under
+the historic perfect-persistence model; with the durability axis enabled
+(fsync_every > 1 or p_lose_unsynced > 0, state.py durability notes) the
+correct algorithm earns it by explicit fsyncs at every state-exposing site,
+and the planted "ack_before_fsync" bug strips the handler-reply ones):
 
-  1. faults     — crash / restart / repartition draws
+  1. faults     — crash / restart / repartition draws; a crash drops the
+                  un-fsynced suffix with p_lose_unsynced (rollback to the
+                  durable_len / durable_term / durable_voted_for watermark)
   2. deliver    — ONE message per (destination, mailbox type) per tick,
                   vectorized over destinations: when several sources are due
                   at the same destination the tick-rotated minimum source
@@ -33,6 +39,9 @@ sends are computed from post-update persistent arrays, mirroring the reference's
                   commit durability) + liveness/stat bookkeeping
   6. compact    — advance the snapshot boundary (commit, or the service
                   layer's apply cursor); a pure index bump, no data movement
+  7. fsync      — background durability: each node syncs its persistent
+                  state every fsync_every ticks (staggered); 1 = the
+                  historic always-durable model
 
 The log is a CANONICAL RING (see state.py): absolute (1-based) index ``a``
 always lives in lane ``(a - 1) & (cap - 1)``; ``base`` (snapshot boundary) and
@@ -130,7 +139,9 @@ def _block_total(n: int) -> int:
     # faults 4n+3 (crash/restart/colors/restart-timers + u_part + asym pair),
     # three timer resets 3n, rv/ae response nets 2n, election timers n,
     # client n, three [n,n] send nets — every (delay, lost) pair packs into
-    # ONE u32 (see _net_draws), which nearly halves the threefry budget
+    # ONE u32 (see _net_draws), which nearly halves the threefry budget.
+    # (the suffix-loss draw rides the free low byte of the color words —
+    # no budget of its own)
     return 11 * n + 3 + 3 * n * n
 
 
@@ -246,7 +257,14 @@ def step_cluster(
     # failures the reference models via connect/disconnect are first-class).
     # Asymmetric cuts accumulate until the next repartition/heal event.
     u_part = blk.uniform(())
-    colors = blk.bern(0.5, (n,))
+    # The coloring tests bits 8..31 (_u01); bits 0..7 of the same words are
+    # free and carry the suffix-loss draw below — the _net_draws packing
+    # idiom (disjoint bit ranges of one threefry word are independent
+    # draws), so the new fault axis leaves the legacy draw layout — and
+    # with it every recorded (seed, cluster) trajectory and tuned storm —
+    # bit-identical.
+    w_colors = blk._take((n,))
+    colors = _DrawBlock._u01(w_colors) < 0.5
     asym_dst = blk.randint(0, n, ())
     asym_off = blk.randint(1, n, ())  # src = dst + off mod n, never == dst
     part_adj = colors[:, None] == colors[None, :]
@@ -274,9 +292,42 @@ def step_cluster(
         | eye
     )
 
+    # Lossy persistence (the madsim `fs` axis; state.py durability notes):
+    # a crash drops the un-fsynced suffix with p_lose_unsynced — the log
+    # rolls back to the durable watermark and term/voted_for to their
+    # fsynced shadows (an atomic pair: both live in the one state file the
+    # last fsync wrote). Applied AT CRASH, not restart: in-flight AE
+    # deliveries read the sender's live ring (read-at-delivery), so a dead
+    # node's lost suffix must already be gone. Ring lanes beyond the rolled
+    # watermark keep their bytes — every reader masks by log_len (the
+    # commit-shadow loop reads up to the stale volatile `commit`, whose
+    # lanes are exactly the pre-crash bytes it already matched). The draw
+    # rides bits 0..7 of the color words (see above): 8-bit resolution,
+    # the same bias class as the _net_draws delay byte.
+    lose = crash & (
+        (w_colors & 0xFF).astype(jnp.float32) * jnp.float32(2.0 ** -8)
+        < kn.p_lose_unsynced
+    )
+    s = s._replace(
+        term=jnp.where(lose, s.durable_term, s.term),
+        voted_for=jnp.where(lose, s.durable_voted_for, s.voted_for),
+        # durable_len >= base always (compaction/install fsync through the
+        # boundary), so the rolled-back window stays legal
+        log_len=jnp.where(lose, s.durable_len, s.log_len),
+    )
+
     term, voted_for = s.term, s.voted_for
     log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
     base, snap_term, prefix_hash = s.base, s.snap_term, s.prefix_hash
+    durable_len = s.durable_len
+    durable_term, durable_voted_for = s.durable_term, s.durable_voted_for
+    # Fsync sites below (correct algorithm): persist-before-reply at the
+    # RV/AE handlers (raft.rs:224-233), persist at election start
+    # (raft.rs:248), persist at leader append (start(), raft.rs:311-313 —
+    # the leader's own log_len is commit-counted, so it must be durable),
+    # persist at install-snapshot and compaction (cond_install_snapshot /
+    # snapshot()), plus the background fsync_every cadence at tick end.
+    # bug == "ack_before_fsync" strips exactly the two HANDLER syncs.
     rv_rsp_t, rv_rsp_term, rv_rsp_granted = s.rv_rsp_t, s.rv_rsp_term, s.rv_rsp_granted
     ae_rsp_t, ae_rsp_term = s.ae_rsp_t, s.ae_rsp_term
     ae_rsp_success, ae_rsp_match = s.ae_rsp_success, s.ae_rsp_match
@@ -404,6 +455,11 @@ def step_cluster(
     )
     commit = jnp.where(inst, jnp.maximum(commit, slen), commit)
     compact_floor = jnp.where(inst, slen, compact_floor)
+    # install persists everything (cond_install_snapshot -> persist()):
+    # base/snap_term/prefix_hash stay durable by construction
+    durable_len = jnp.where(inst, log_len, durable_len)
+    durable_term = jnp.where(inst, term, durable_term)
+    durable_voted_for = jnp.where(inst, voted_for, durable_voted_for)
     src_id = picked(pick, jnp.broadcast_to(me[None, :], (n, n)))
     snap_installed_src = jnp.where(inst, src_id, snap_installed_src)
     snap_installed_len = jnp.where(inst, slen, snap_installed_len)
@@ -441,6 +497,14 @@ def step_cluster(
     ) & log_ok
     voted_for = jnp.where(grant, src_id, voted_for)
     timer = jnp.where(grant, _timeout_draw(kn, blk, (n,)), timer)
+    if cfg.bug != "ack_before_fsync":
+        # persist-before-reply (raft.rs:224-233): the response exposes
+        # term and (via the grant) voted_for — fsync them first. Under the
+        # planted bug the reply leaves from volatile state: a voter can
+        # grant, crash, revert its vote, and re-grant the term to a rival.
+        durable_term = jnp.where(got, term, durable_term)
+        durable_voted_for = jnp.where(got, voted_for, durable_voted_for)
+        durable_len = jnp.where(got, log_len, durable_len)
     delay, lost = _net_draws(kn, blk, (n,))
     send = got & ~lost  # per voter (one response per tick)
     # response slot [candidate, voter] <- the picked (voter, candidate) pair
@@ -541,8 +605,17 @@ def step_cluster(
     ent_t = jnp.sum(jnp.where(slot_oh, plog_t[:, None, :], 0), axis=-1)
     ent_v = jnp.sum(jnp.where(slot_oh, plog_v[:, None, :], 0), axis=-1)
     old_t = jnp.sum(jnp.where(slot_oh, log_term[:, None, :], 0), axis=-1)
-    conflict_any = jnp.any(
-        in_batch & (abs_e <= log_len[:, None]) & (old_t != ent_t), axis=1
+    conf_e = in_batch & (abs_e <= log_len[:, None]) & (old_t != ent_t)
+    conflict_any = jnp.any(conf_e, axis=1)
+    # Disk truncation is synchronous (the state file shrinks in place) but
+    # the rewritten suffix is an ASYNC append until the next fsync: the
+    # durable watermark drops to just below the first conflicting index.
+    # Overwrites at matching (index, term) are byte-identical (log
+    # matching) and cost no durability. In correct mode the handler fsync
+    # below restores durable_len = log_len in the same tick.
+    first_conf = jnp.min(jnp.where(conf_e, abs_e, _BIG), axis=1)
+    durable_len = jnp.where(
+        conflict_any, jnp.minimum(durable_len, first_conf - 1), durable_len
     )
     hit = in_batch[..., None] & slot_oh               # [n, e, cap]
     any_hit = jnp.any(hit, axis=1)
@@ -582,6 +655,16 @@ def step_cluster(
         jnp.maximum(jnp.where(has_cand, first_abs - 1, base), base),
     )
     rsp_match = jnp.where(success, batch_end, hint)
+    if cfg.bug != "ack_before_fsync":
+        # persist-before-reply: the ack (rsp_match) exposes the appended
+        # suffix — fsync before it leaves. Under the planted bug a
+        # follower acks from volatile state; the leader commit-counts the
+        # ack, the follower crashes inside the fsync window, and the
+        # "committed" entry evaporates from the only majority that had it
+        # (the commit-shadow / prefix-hash durability oracles must fire).
+        durable_len = jnp.where(got, log_len, durable_len)
+        durable_term = jnp.where(got, term, durable_term)
+        durable_voted_for = jnp.where(got, voted_for, durable_voted_for)
     delay, lost = _net_draws(kn, blk, (n,))
     send = got & ~lost  # per follower (one response per tick)
     # KEEP-OLDEST for periodically-regenerated messages: an occupied slot
@@ -619,6 +702,10 @@ def step_cluster(
     log_term = jnp.where(nop_hit, term[:, None], log_term)
     log_val = jnp.where(nop_hit, NOOP_CMD, log_val)
     log_len = jnp.where(nop, log_len + 1, log_len)
+    # leader appends persist at append (start() -> persist()): the eye row
+    # of the commit count below reads log_len, so it must be durable. The
+    # winner's term/voted_for were fsynced at candidacy and are unchanged.
+    durable_len = jnp.where(nop, log_len, durable_len)
 
     # ------------------------------------------------- timers: election timeout
     running = alive & (role != LEADER)
@@ -629,6 +716,11 @@ def step_cluster(
     voted_for = jnp.where(fired, me, voted_for)
     votes = jnp.where(fired[:, None], eye, votes)
     timer = jnp.where(fired, _timeout_draw(kn, blk, (n,)), timer)
+    # start_election persists before any RequestVote leaves (raft.rs:248).
+    # Kept under ack_before_fsync: the bug strips only the HANDLER replies.
+    durable_term = jnp.where(fired, term, durable_term)
+    durable_voted_for = jnp.where(fired, voted_for, durable_voted_for)
+    durable_len = jnp.where(fired, log_len, durable_len)
 
     llt = jnp.where(
         log_len > base, _row_gather(log_term, _slot(log_len, cap), cap), snap_term
@@ -653,6 +745,7 @@ def step_cluster(
     log_term = jnp.where(inj_hit, term[:, None], log_term)
     log_val = jnp.where(inj_hit, cmd_val[:, None], log_val)
     log_len = jnp.where(inject, log_len + 1, log_len)
+    durable_len = jnp.where(inject, log_len, durable_len)  # start()->persist
     next_cmd = s.next_cmd + jnp.any(inject).astype(I32)
 
     # -------------------------------------------- leader heartbeat / replication
@@ -824,6 +917,25 @@ def step_cluster(
     )
     snap_term = jnp.where(do_compact, new_snap_term, snap_term)
     base = jnp.where(do_compact, boundary, base)
+    # Writing the snapshot file is itself a durable write (snapshot() ->
+    # persist()): everything through the new boundary is on disk, which
+    # keeps base <= durable_len even when a bug let commit outrun the
+    # watermark. The suffix past the boundary stays volatile.
+    durable_len = jnp.where(
+        do_compact, jnp.maximum(durable_len, boundary), durable_len
+    )
+
+    # ------------------------------------------------------- background fsync
+    # Per-node staggered cadence (stagger avoids a lockstep all-nodes-sync
+    # artifact): node i syncs its full persistent state every fsync_every
+    # ticks. fsync_every=1 -> durable == live at every tick end, i.e. the
+    # historic perfect-persistence model (and the default). The traced-int
+    # modulo is one [n] op per tick — noise next to the [n, cap] phases
+    # (the _DrawBlock modulo cliff was per-draw at [n, n] scale).
+    do_fsync = alive & ((t + me) % kn.fsync_every == 0)
+    durable_len = jnp.where(do_fsync, log_len, durable_len)
+    durable_term = jnp.where(do_fsync, term, durable_term)
+    durable_voted_for = jnp.where(do_fsync, voted_for, durable_voted_for)
 
     return ClusterState(
         tick=t,
@@ -831,6 +943,8 @@ def step_cluster(
         log_term=log_term, log_val=log_val, log_len=log_len,
         base=base, snap_term=snap_term, prefix_hash=prefix_hash,
         commit=commit, compact_floor=compact_floor,
+        durable_len=durable_len, durable_term=durable_term,
+        durable_voted_for=durable_voted_for,
         votes=votes, next_idx=next_idx, match_idx=match_idx, adj=adj,
         rv_req_t=rv_req_t, rv_req_term=rv_req_term,
         rv_req_lli=rv_req_lli, rv_req_llt=rv_req_llt,

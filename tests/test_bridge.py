@@ -216,8 +216,11 @@ def test_bridge_replays_planted_bug_classes():
     fault schedule, is what breaks safety. (Measured odds: ~16/16 schedules
     class-match for these two bugs; commit_any_term / forget_voted_for have
     much thinner per-schedule odds on the C++ side's independent election
-    timing, so the cross-backend leg pins the two robust ones and
-    tests/test_tpusim_bugs.py covers all four on the batched side.)"""
+    timing — as does ack_before_fsync, whose C++ manifestation additionally
+    needs a kill to land between a handler reply and the next unrelated
+    persist() — so the cross-backend leg pins the two robust ones and
+    tests/test_tpusim_bugs.py covers the full library on the batched
+    side.)"""
     import dataclasses
 
     from tests.test_tpusim_bugs import STORM as storm  # single tuned profile

@@ -28,6 +28,7 @@ from madraft_tpu.tpusim.config import (
     VIOLATION_COMMIT_SHADOW,
     VIOLATION_DUAL_LEADER,
     VIOLATION_LOG_MATCHING,
+    VIOLATION_PREFIX_DIVERGE,
 )
 
 # The tuned storms live in config.storm_profiles() — ONE source shared with
@@ -42,6 +43,7 @@ _PROFILES = storm_profiles()
 STORM = _PROFILES["storm"][0]
 FIG8 = _PROFILES["fig8"][0]
 REVOTE = _PROFILES["revote"][0]
+DURABILITY = _PROFILES["durability"][0]
 
 
 def test_profiles_scale_matches_demonstrations():
@@ -50,9 +52,11 @@ def test_profiles_scale_matches_demonstrations():
     assert _PROFILES["fig8"][1:3] == (1024, 1000)
     assert _PROFILES["revote"][1:3] == (2048, 1000)
     assert _PROFILES["storm"][1:3] == (256, 600)
+    assert _PROFILES["durability"][1:3] == (256, 600)
     assert "commit_any_term" in _PROFILES["fig8"][3]
     assert "forget_voted_for" in _PROFILES["revote"][3]
     assert set(_PROFILES["storm"][3]) == {"grant_any_vote", "no_truncate"}
+    assert set(_PROFILES["durability"][3]) == {"ack_before_fsync"}
 
 
 def _bits(rep):
@@ -94,6 +98,20 @@ def test_bug_no_truncate_caught():
                n_clusters=256, n_ticks=600)
     assert rep.n_violating > 0, "truncation bug escaped the oracles"
     assert (_bits(rep) & (VIOLATION_LOG_MATCHING | VIOLATION_COMMIT_SHADOW)).any()
+
+
+def test_bug_ack_before_fsync_caught():
+    # The classic "reply before fsync" production bug: RV/AE handlers ack
+    # from volatile state. Under the durability storm (every crash drops
+    # the un-fsynced suffix, background fsync every 8 ticks) a follower's
+    # acked-but-volatile entries get commit-counted, crash away, and a
+    # later leader re-mints their indices — the commit-shadow / prefix-hash
+    # durability oracles must fire. The same storm with the correct
+    # algorithm is pinned clean by tests/test_tpusim_durability.py.
+    rep = fuzz(DURABILITY.replace(bug="ack_before_fsync"), seed=8,
+               n_clusters=256, n_ticks=600)
+    assert rep.n_violating > 0, "ack-before-fsync bug escaped the oracles"
+    assert (_bits(rep) & (VIOLATION_COMMIT_SHADOW | VIOLATION_PREFIX_DIVERGE)).any()
 
 
 def test_clean_storms_stay_clean():

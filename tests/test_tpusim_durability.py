@@ -1,0 +1,142 @@
+"""The storage-durability fault axis (madsim's `fs` layer: crash/restore
+with PARTIALLY durable files — SURVEY §0/§2.6; state.py durability notes).
+
+Every node carries an fsync watermark (durable_len + durable term/voted_for
+shadows); writes become durable when an fsync boundary passes (the
+``fsync_every`` cadence or one of the explicit persist sites), and a crash
+with ``p_lose_unsynced`` rolls the log/term/vote back to the watermark. The
+correct algorithm fsyncs before every state-exposing emission
+(persist-before-reply, raft.rs:224-233), so it must stay clean under a
+full-loss crash storm; the planted ``ack_before_fsync`` bug (the classic
+"reply before fsync" production consensus bug) strips exactly the handler
+syncs and must be caught — see test_tpusim_bugs.py for the catch row.
+"""
+
+import jax
+import numpy as np
+
+from madraft_tpu.tpusim import SimConfig, fuzz
+from madraft_tpu.tpusim.config import storm_profiles
+from madraft_tpu.tpusim.engine import replay_cluster
+from madraft_tpu.tpusim.state import init_cluster
+from madraft_tpu.tpusim.step import step_cluster
+
+_PROFILES = storm_profiles()
+DURABILITY = _PROFILES["durability"][0]
+
+
+def test_clean_under_suffix_loss_storm():
+    # The correct algorithm under TOTAL suffix loss (every crash drops the
+    # un-fsynced tail) and a slow background fsync: persist-before-reply
+    # must keep every committed entry on a durable majority — zero
+    # violations, and the storm must still commit (the axis is not clean
+    # merely because nothing happened).
+    assert DURABILITY.p_lose_unsynced == 1.0
+    assert DURABILITY.fsync_every > DURABILITY.delay_max  # real volatility
+    rep = fuzz(DURABILITY, seed=1, n_clusters=256, n_ticks=600)
+    assert rep.n_violating == 0, (
+        f"false positive under suffix-loss storm: "
+        f"{np.unique(rep.violations[rep.violating_clusters()])}"
+    )
+    assert (rep.committed > 0).mean() > 0.9, "storm starved commit progress"
+
+
+def _scan_cluster(cfg, seed, n_ticks, cluster_id=0):
+    """Single-cluster trajectory of (durable_len, log_len, base,
+    durable_term, term) per tick."""
+    ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
+    kn = cfg.knobs()
+
+    @jax.jit
+    def run(key):
+        def body(carry, _):
+            nxt = step_cluster(cfg, carry, key, kn)
+            return nxt, (nxt.durable_len, nxt.log_len, nxt.base,
+                         nxt.durable_term, nxt.term)
+
+        return jax.lax.scan(
+            body, init_cluster(cfg, key, kn), None, length=n_ticks
+        )[1]
+
+    return [np.asarray(x) for x in jax.block_until_ready(run(ckey))]
+
+
+def test_watermark_invariants_under_storm():
+    # base <= durable_len <= log_len at every tick (the rolled-back window
+    # stays legal and disk never claims more than memory), and the durable
+    # term shadow is monotone (a crash rolls the LIVE term back to it, never
+    # it below itself).
+    dlen, llen, base, dterm, term = _scan_cluster(DURABILITY, 5, 500)
+    assert (dlen <= llen).all(), "watermark claims more than the live log"
+    assert (base <= dlen).all(), (
+        "snapshot boundary passed the watermark — a crash could roll the "
+        "log below its own base"
+    )
+    assert (dterm <= term).all()
+    assert (np.diff(dterm, axis=0) >= 0).all(), "durable term went backward"
+
+
+def test_fsync_every_tick_is_perfect_persistence():
+    # fsync_every=1 (the default): durable == live at every tick end — the
+    # historic model, under which p_lose_unsynced can never bite.
+    cfg = DURABILITY.replace(fsync_every=1)
+    dlen, llen, base, dterm, term = _scan_cluster(cfg, 3, 300)
+    assert (dlen == llen).all()
+    assert (dterm == term).all()
+
+
+def test_inert_axis_leaves_reports_unchanged():
+    # p_lose_unsynced=0 gates the whole axis: a lazy fsync cadence alone
+    # must not change a single report field (the rollback is the only
+    # consumer of the watermark) — and the knobs being dynamic, both runs
+    # share one compiled program.
+    storm = _PROFILES["storm"][0]
+    a = fuzz(storm, seed=7, n_clusters=64, n_ticks=300)
+    b = fuzz(storm.replace(fsync_every=8), seed=7, n_clusters=64, n_ticks=300)
+    for f in a._fields:
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+
+
+def test_suffix_loss_draws_are_deterministic():
+    # The new fault draw (the low byte of the color words) is a pure
+    # function of (seed, cluster, tick): double-running the bug storm must
+    # be bit-identical — the MADSIM_TEST_CHECK_DETERMINISTIC contract holds
+    # on the new axis.
+    cfg = DURABILITY.replace(bug="ack_before_fsync")
+    a = fuzz(cfg, seed=1, n_clusters=64, n_ticks=300)
+    b = fuzz(cfg, seed=1, n_clusters=64, n_ticks=300)
+    for f in a._fields:
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+    assert a.n_violating > 0  # the small storm still manifests the bug
+
+
+def test_replay_reproduces_durability_violation():
+    # The (seed, cluster_id) replay contract extends to the new axis: a
+    # violating cluster found by the batched bug sweep reproduces exactly
+    # in the single-cluster replayer.
+    cfg = DURABILITY.replace(bug="ack_before_fsync")
+    rep = fuzz(cfg, seed=1, n_clusters=64, n_ticks=300)
+    assert rep.n_violating > 0
+    cid = int(rep.violating_clusters()[0])
+    st = replay_cluster(cfg, seed=1, cluster_id=cid, n_ticks=300)
+    assert int(st.violations) == int(rep.violations[cid])
+    assert int(st.first_violation_tick) == int(rep.first_violation_tick[cid])
+
+
+def test_durability_knob_validation():
+    import pytest
+
+    from madraft_tpu.tpusim.engine import _validate_knobs
+
+    with pytest.raises(ValueError, match="fsync_every"):
+        SimConfig(fsync_every=0)
+    with pytest.raises(ValueError, match="p_lose_unsynced"):
+        SimConfig(p_lose_unsynced=1.5)
+    with pytest.raises(ValueError, match="fsync_every"):
+        _validate_knobs(
+            SimConfig().knobs()._replace(fsync_every=np.int32(0))
+        )
+    with pytest.raises(ValueError, match="p_lose_unsynced"):
+        _validate_knobs(
+            SimConfig().knobs()._replace(p_lose_unsynced=np.float32(-0.1))
+        )

@@ -481,6 +481,10 @@ def test_shardkv_computed_ctrler_config_guards():
         ShardKvConfig(computed_ctrler=True, live_ctrler=True)
     with pytest.raises(ValueError, match="stale_ctrler_read"):
         ShardKvConfig(computed_ctrler=True, bug_stale_ctrler_read=True)
+    # the flip_b "always a DIFFERENT gid" invariant degenerates with one
+    # group (ADVICE round-5 finding #4)
+    with pytest.raises(ValueError, match="n_groups"):
+        ShardKvConfig(computed_ctrler=True, n_groups=1)
     from madraft_tpu.tpusim.shardkv import make_shardkv_sweep_fn
 
     kcfg = SKV.replace(cfg_interval=40)
