@@ -51,11 +51,45 @@ fi
 
 echo "== [4/5] Python/TPU-sim suite (8-device virtual CPU mesh)"
 python -m pytest tests/ --ignore tests/test_cpp_suite.py -q
-# durability-storm smoke: the correct algorithm under TOTAL un-fsynced
-# suffix loss (the madsim `fs` axis; --profile durability) must report
-# zero violations — the CLI exits 1 on any violating cluster
-MADTPU_PLATFORM=cpu python -m madraft_tpu fuzz --profile durability \
-  --clusters 64 --ticks 300 --seed 12345
+# durability smoke + flight-recorder smoke + hot-path guard (ISSUE 2). The
+# golden "clean" leg IS the durability-storm smoke (same argv: the correct
+# algorithm under total un-fsynced suffix loss must report zero violations
+# and exit 0); the "bug" leg must exit 1; both fixed-seed fuzz REPORTs must
+# match the pre-PR golden bit-identically (tracing/telemetry add zero
+# hot-path cost); and the planted-bug cluster must decode to a non-empty
+# explain timeline (explain is a debugging tool — exit 0).
+MADTPU_PLATFORM=cpu python - <<'PY'
+import contextlib, io, json, pathlib
+from madraft_tpu.__main__ import main
+
+golden = json.loads(pathlib.Path("tests/golden_fuzz.json").read_text())
+for leg, want_rc in (("clean", 0), ("bug", 1)):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(golden[leg]["argv"])
+    assert rc == want_rc, f"[{leg}] fuzz exit {rc} != {want_rc}"
+    live = json.loads(buf.getvalue().strip().splitlines()[-1])
+    for k, want in golden[leg]["report"].items():
+        assert live[k] == want, f"hot-path drift [{leg}] {k}: {live[k]} != {want}"
+# explain the golden bug leg's first violating cluster — coordinates come
+# from the golden file so a deliberate regeneration cannot strand them here
+bad = golden["bug"]["report"]["violating_clusters"][0]
+opts = dict(zip(golden["bug"]["argv"][1::2], golden["bug"]["argv"][2::2]))
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["explain", "--profile", opts["--profile"],
+               "--bug", opts["--bug"], "--seed", opts["--seed"],
+               "--ticks", opts["--ticks"], "--cluster", str(bad),
+               "--window", "25"])
+lines = buf.getvalue().strip().splitlines()
+header = json.loads(lines[0])
+assert rc == 0 and len(lines) > 1, "explain must exit 0 with a timeline"
+assert header["violation_names"], header
+print(f"explain smoke: {len(lines) - 1} events, "
+      f"names={header['violation_names']}, "
+      f"first_violation_tick={header['first_violation_tick']}; "
+      "fixed-seed fuzz golden OK")
+PY
 
 echo "== [5/5] bench smoke (1024 clusters x 128 ticks)"
 # prefer the attached accelerator; fall back to CPU if it is absent or hung

@@ -39,6 +39,12 @@ struct Schedule {
   std::string bug;                // planted bug name ("" = correct algorithm;
   //                                 raftcore raft.cpp bug(), config.py RAFT_BUGS)
   uint64_t seed = 0;
+  bool trace = false;             // per-tick state export ("trace 1" line):
+  //                                 the report gains a "trace" object with
+  //                                 alive/leader masks and per-node
+  //                                 term/commit/len arrays, one row per tick
+  //                                 — the C++ half of the bridge's
+  //                                 divergence localization (bridge.py)
   std::vector<Event> events;      // sorted by tick
 };
 
@@ -62,6 +68,10 @@ inline bool parse_schedule(FILE* f, Schedule* out) {
       if (!madtpu_tools::is_known_raft_bug(out->bug)) return false;
     } else if (!std::strcmp(kw, "seed")) {
       std::sscanf(line, "%*s %" SCNu64, &out->seed);
+    } else if (!std::strcmp(kw, "trace")) {
+      int v = 0;
+      std::sscanf(line, "%*s %d", &v);
+      out->trace = v != 0;
     } else if (!std::strcmp(kw, "ev")) {
       Event ev{};
       char kind[32];
@@ -106,6 +116,9 @@ struct Replay {
   bool apply_disorder = false;
   uint64_t first_violation_ms = 0;
   uint64_t max_applied = 0;
+  // per-tick flight-recorder samples (Schedule::trace; one row per tick)
+  std::vector<uint64_t> tr_alive, tr_leader;          // node bitmasks
+  std::vector<std::vector<uint64_t>> tr_term, tr_commit, tr_len;
 
   Replay(Sim* s, int n_) : sim(s), n(n_) {
     for (int i = 0; i < n; i++) addrs.push_back(make_addr(0, 0, 1, i + 1));
@@ -186,6 +199,34 @@ inline simcore::Task<void> leader_poll_task(Replay* r, uint64_t end_ns) {
   }
 }
 
+// Flight-recorder sampler (Schedule::trace): one state snapshot per tick,
+// taken 1ns PAST the tick boundary so the sample deterministically follows
+// the driver's fault events scheduled AT the boundary — C++ sample k then
+// corresponds to the TPU trace's post-tick state at tick k, and the alive
+// masks must match the schedule exactly (the bridge's strongest
+// cross-backend divergence signal).
+inline simcore::Task<void> trace_task(Replay* r, const Schedule* sch) {
+  for (uint64_t k = 1; k <= sch->ticks; k++) {
+    uint64_t at = k * sch->ms_per_tick * MSEC + 1;
+    if (at > r->sim->now()) co_await r->sim->sleep(at - r->sim->now());
+    uint64_t am = 0, lm = 0;
+    std::vector<uint64_t> tm(r->n, 0), cm(r->n, 0), ln(r->n, 0);
+    for (int i = 0; i < r->n; i++) {
+      if (!r->rafts[i]) continue;
+      am |= 1ull << i;
+      if (r->rafts[i]->is_leader()) lm |= 1ull << i;
+      tm[i] = r->rafts[i]->term();
+      cm[i] = r->rafts[i]->commit_index();
+      ln[i] = r->rafts[i]->last_index();
+    }
+    r->tr_alive.push_back(am);
+    r->tr_leader.push_back(lm);
+    r->tr_term.push_back(std::move(tm));
+    r->tr_commit.push_back(std::move(cm));
+    r->tr_len.push_back(std::move(ln));
+  }
+}
+
 inline simcore::Task<void> replay_driver(Sim* sim, Replay* r,
                                          const Schedule* sch) {
   for (int i = 0; i < r->n; i++) {
@@ -195,6 +236,7 @@ inline simcore::Task<void> replay_driver(Sim* sim, Replay* r,
   uint64_t end_ns = sch->ticks * sch->ms_per_tick * MSEC;
   sim->spawn(Addr(0), client_task(r, end_ns));       // TaskRef is non-owning
   sim->spawn(Addr(0), leader_poll_task(r, end_ns));  // (drop = detach)
+  if (sch->trace) sim->spawn(Addr(0), trace_task(r, sch));
 
   uint64_t alive = ~0ull;
   for (const auto& ev : sch->events) {
@@ -218,7 +260,13 @@ inline simcore::Task<void> replay_driver(Sim* sim, Replay* r,
         }
     }
   }
-  if (end_ns > sim->now()) co_await sim->sleep(end_ns - sim->now());
+  // when tracing, run 2ns past the horizon so the sampler's final snapshot
+  // (at end_ns + 1) deterministically lands before the sim stops. The
+  // window is nanoseconds, not a tick, so no raft traffic or applier work
+  // can fire inside it — the traced run observes exactly the same
+  // simulation the untraced (classified) run did.
+  uint64_t drain_ns = end_ns + (sch->trace ? 2 : 0);
+  if (drain_ns > sim->now()) co_await sim->sleep(drain_ns - sim->now());
 }
 
 // Run a schedule; returns the one-line JSON report ("" = sim deadlock).
@@ -243,10 +291,38 @@ inline std::string run_schedule(const Schedule& sch) {
       out, sizeof out,
       "{\"dual_leader\": %d, \"commit_mismatch\": %d, \"apply_disorder\": %d, "
       "\"first_violation_ms\": %" PRIu64 ", \"max_applied\": %" PRIu64
-      ", \"rpcs\": %" PRIu64 "}",
+      ", \"rpcs\": %" PRIu64,
       (int)r.dual_leader, (int)r.commit_mismatch, (int)r.apply_disorder,
       r.first_violation_ms, r.max_applied, sim.msg_count() / 2);
-  return out;
+  std::string report(out);
+  if (sch.trace) {
+    auto masks = [](const std::vector<uint64_t>& v) {
+      std::string s = "[";
+      for (size_t i = 0; i < v.size(); i++) {
+        if (i) s += ",";
+        s += std::to_string(v[i]);
+      }
+      return s + "]";
+    };
+    auto rows = [&](const std::vector<std::vector<uint64_t>>& m) {
+      std::string s = "[";
+      for (size_t i = 0; i < m.size(); i++) {
+        if (i) s += ",";
+        s += masks(m[i]);
+      }
+      return s + "]";
+    };
+    report += ", \"trace\": {\"ms_per_tick\": ";
+    report += std::to_string(sch.ms_per_tick);
+    report += ", \"alive\": " + masks(r.tr_alive);
+    report += ", \"leader\": " + masks(r.tr_leader);
+    report += ", \"term\": " + rows(r.tr_term);
+    report += ", \"commit\": " + rows(r.tr_commit);
+    report += ", \"len\": " + rows(r.tr_len);
+    report += "}";
+  }
+  report += "}";
+  return report;
 }
 
 }  // namespace madtpu_replay

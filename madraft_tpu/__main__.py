@@ -7,17 +7,22 @@
     python -m madraft_tpu shardkv-fuzz --clusters 64  --ticks 640
     python -m madraft_tpu sweep       --loss 0,0.1,0.3 --crash 0,0.02
     python -m madraft_tpu replay      --seed S --cluster C --ticks T [--storm]
+    python -m madraft_tpu explain     --seed S --cluster C --ticks T [--window W]
     python -m madraft_tpu bridge      --seed S --cluster C --ticks T [--storm]
 
-Every command prints one JSON line (machine-readable; violations are data).
-A violating cluster reported by `fuzz` is reproduced exactly by `replay`
-with the same (seed, cluster) — the MADSIM_TEST_SEED replay contract — and
-`bridge` closes the loop by re-running its fault schedule on the C++
-runtime via the in-process bindings (madraft_tpu.simcore). The fuzz
-commands accept `--check-deterministic` (or the env var
-MADTPU_TEST_CHECK_DETERMINISTIC, the C++ runner's spelling) to double-run
-and demand a bit-identical report — the MADSIM_TEST_CHECK_DETERMINISTIC
-analogue.
+Every command prints one JSON line (machine-readable; violations are data;
+fuzz/sweep reports carry run telemetry — compile vs execute wall, steps/s,
+device, backend). A violating cluster reported by `fuzz` is reproduced
+exactly by `replay` with the same (seed, cluster) — the MADSIM_TEST_SEED
+replay contract; `explain` re-runs it with the flight recorder on
+(tpusim/trace.py) and prints the decoded event timeline around the first
+violation (or a Perfetto export via --format chrome) — and `bridge` closes
+the loop by re-running its fault schedule on the C++ runtime via the
+in-process bindings (madraft_tpu.simcore), localizing the first divergence
+tick when the violation class fails to reproduce. The fuzz commands accept
+`--check-deterministic` (or the env var MADTPU_TEST_CHECK_DETERMINISTIC,
+the C++ runner's spelling) to double-run and demand a bit-identical report
+— the MADSIM_TEST_CHECK_DETERMINISTIC analogue.
 """
 
 from __future__ import annotations
@@ -42,6 +47,10 @@ def _sim_config(args):
 
     profiles = storm_profiles()
     prof = getattr(args, "profile", "")
+    # the budget/manifestation warnings are fuzz advice — meaningless for
+    # the single-cluster verbs (replay/explain/bridge, which carry
+    # --cluster and re-run one already-known cluster)
+    single_cluster = getattr(args, "cluster", None) is not None
     if prof:
         cfg, rec_clusters, rec_ticks, _bugs = profiles[prof]
         # the profile owns topology and fault knobs (--nodes/--storm do not
@@ -52,8 +61,8 @@ def _sim_config(args):
                 f"madtpu: warning: --storm is ignored — profile {prof!r} "
                 "defines the full fault storm", file=sys.stderr,
             )
-        if args.bug and (args.clusters * args.ticks
-                         < rec_clusters * rec_ticks):
+        if args.bug and not single_cluster and (
+                args.clusters * args.ticks < rec_clusters * rec_ticks):
             print(
                 f"madtpu: warning: profile {prof!r} demonstrated "
                 f"{args.bug!r} at --clusters {rec_clusters} --ticks "
@@ -64,7 +73,7 @@ def _sim_config(args):
         cfg = SimConfig(n_nodes=args.nodes)
         if args.storm:
             cfg = _storm(cfg)
-        if args.bug:
+        if args.bug and not single_cluster:
             # each bug needs its tuned storm; at generic settings the buggy
             # branch often never executes and the report is bit-identical to
             # the correct program's (round-3 verdict)
@@ -135,19 +144,51 @@ def _det_check(args, rep, rerun):
     return {"deterministic": bool(same)}, not same
 
 
-def _finish_fuzz(args, run):
-    """Run a fuzz closure, optionally double-run for the determinism check,
-    print the JSON report, and return the exit code."""
-    rep = run()
+def _finish_fuzz(args, fn, rep_fn):
+    """AOT-compile the fuzz program (timed), run it (timed), optionally
+    double-run for the determinism check, and print the JSON report with
+    per-invocation run telemetry (compile vs execute wall, steps/s, device,
+    backend — throughput is observable per run, not only via bench.py)."""
+    import jax
+
+    from madraft_tpu.tpusim.engine import run_telemetry
+
+    rep, tele = run_telemetry(
+        fn, rep_fn, args.seed, args.clusters * args.ticks
+    )
+
+    def run():
+        return rep_fn(jax.block_until_ready(fn(args.seed)))
+
     extra, det_failed = _det_check(args, rep, run)
-    _report_json(rep, {"seed": args.seed, **extra})
+    _report_json(rep, {"seed": args.seed, "telemetry": tele, **extra})
     return 1 if (rep.n_violating or det_failed) else 0
 
 
+def _violation_union(rep) -> int:
+    """OR of every violation bitmask in the report (incl. the shardkv
+    report's separate per-group raft masks)."""
+    import numpy as np
+
+    union = 0
+    for field in ("violations", "raft_violations"):
+        v = np.asarray(getattr(rep, field, np.zeros(0, np.int64))).ravel()
+        if v.size:
+            union |= int(np.bitwise_or.reduce(v))
+    return union
+
+
 def _report_json(rep, extra=None):
+    from madraft_tpu.tpusim.config import violation_names
+
+    bad = rep.violating_clusters()
     out = {
         "violating": int(rep.n_violating),
-        "violating_clusters": [int(c) for c in rep.violating_clusters()[:16]],
+        "violating_clusters": [int(c) for c in bad[:16]],
+        # the list above truncates at 16 — carry the full count so coverage
+        # accounting never under-reads
+        "violating_clusters_total": int(bad.size),
+        "violation_names": violation_names(_violation_union(rep)),
     }
     for f in rep._fields:
         v = getattr(rep, f)
@@ -159,15 +200,11 @@ def _report_json(rep, extra=None):
 
 
 def cmd_fuzz(args):
-    from madraft_tpu.tpusim.engine import fuzz
+    from madraft_tpu.tpusim.engine import make_fuzz_fn, report
 
-    mesh = _mesh(args)
-
-    def run():
-        return fuzz(_sim_config(args), seed=args.seed,
-                    n_clusters=args.clusters, n_ticks=args.ticks, mesh=mesh)
-
-    return _finish_fuzz(args, run)
+    fn = make_fuzz_fn(_sim_config(args), args.clusters, args.ticks,
+                      mesh=_mesh(args))
+    return _finish_fuzz(args, fn, report)
 
 
 def _service_bugs(cfg_cls) -> set:
@@ -198,7 +235,7 @@ def _with_service_bug(kcfg, name):
 
 
 def cmd_kv_fuzz(args):
-    from madraft_tpu.tpusim.kv import KvConfig, kv_fuzz
+    from madraft_tpu.tpusim.kv import KvConfig, kv_report, make_kv_fuzz_fn
 
     cfg = _sim_config(args).replace(
         p_client_cmd=0.0, compact_at_commit=False, compact_every=16
@@ -210,17 +247,17 @@ def cmd_kv_fuzz(args):
         args.service_bug,
     )
 
-    mesh = _mesh(args)
-
-    def run():
-        return kv_fuzz(cfg, kcfg, seed=args.seed,
-                       n_clusters=args.clusters, n_ticks=args.ticks, mesh=mesh)
-
-    return _finish_fuzz(args, run)
+    fn = make_kv_fuzz_fn(cfg, kcfg, args.clusters, args.ticks,
+                         mesh=_mesh(args))
+    return _finish_fuzz(args, fn, kv_report)
 
 
 def cmd_ctrler_fuzz(args):
-    from madraft_tpu.tpusim.ctrler import CtrlerConfig, ctrler_fuzz
+    from madraft_tpu.tpusim.ctrler import (
+        CtrlerConfig,
+        ctrler_report,
+        make_ctrler_fuzz_fn,
+    )
 
     cfg = _sim_config(args).replace(
         p_client_cmd=0.0, compact_at_commit=False, log_cap=32, compact_every=8
@@ -230,20 +267,18 @@ def cmd_ctrler_fuzz(args):
         args.service_bug,
     )
 
-    mesh = _mesh(args)
-
-    def run():
-        return ctrler_fuzz(
-            cfg, kcfg,
-            seed=args.seed, n_clusters=args.clusters, n_ticks=args.ticks,
-            mesh=mesh)
-
-    return _finish_fuzz(args, run)
+    fn = make_ctrler_fuzz_fn(cfg, kcfg, args.clusters, args.ticks,
+                             mesh=_mesh(args))
+    return _finish_fuzz(args, fn, ctrler_report)
 
 
 def cmd_shardkv_fuzz(args):
     from madraft_tpu.tpusim import SimConfig
-    from madraft_tpu.tpusim.shardkv import ShardKvConfig, shardkv_fuzz
+    from madraft_tpu.tpusim.shardkv import (
+        ShardKvConfig,
+        make_shardkv_fuzz_fn,
+        shardkv_report,
+    )
 
     cfg = SimConfig(
         n_nodes=args.nodes, p_client_cmd=0.0, compact_at_commit=False,
@@ -274,15 +309,9 @@ def cmd_shardkv_fuzz(args):
         args.service_bug,
     )
 
-    mesh = _mesh(args)
-
-    def run():
-        return shardkv_fuzz(
-            cfg, kcfg,
-            seed=args.seed, n_clusters=args.clusters,
-            n_ticks=args.ticks, mesh=mesh)
-
-    return _finish_fuzz(args, run)
+    fn = make_shardkv_fuzz_fn(cfg, kcfg, args.clusters, args.ticks,
+                              mesh=_mesh(args))
+    return _finish_fuzz(args, fn, shardkv_report)
 
 
 def cmd_sweep(args):
@@ -345,8 +374,11 @@ def cmd_sweep(args):
     def run():
         return report(jax.block_until_ready(fn(args.seed)))
 
-    rep = run()
+    from madraft_tpu.tpusim.engine import run_telemetry
+
+    rep, tele = run_telemetry(fn, report, args.seed, n * args.ticks)
     extra, det_failed = _det_check(args, rep, run)
+    extra["telemetry"] = tele
     cells = []
     for i, c in enumerate(combos):
         sl = slice(i * per, (i + 1) * per)
@@ -372,6 +404,7 @@ def cmd_sweep(args):
 def cmd_replay(args):
     import numpy as np
 
+    from madraft_tpu.tpusim.config import violation_names
     from madraft_tpu.tpusim.engine import replay_cluster
 
     st = replay_cluster(_sim_config(args), args.seed, args.cluster, args.ticks)
@@ -379,6 +412,7 @@ def cmd_replay(args):
         "seed": args.seed,
         "cluster": args.cluster,
         "violations": int(st.violations),
+        "violation_names": violation_names(int(st.violations)),
         "first_violation_tick": int(st.first_violation_tick),
         "committed": int(st.shadow_len),
         "terms": np.asarray(st.term).tolist(),
@@ -386,18 +420,81 @@ def cmd_replay(args):
     return 1 if int(st.violations) else 0
 
 
+def cmd_explain(args):
+    """Flight-recorder replay of ONE cluster: decode the per-tick trace into
+    a structured event timeline (JSONL around the first violation) or a
+    Perfetto-loadable chrome trace. A debugging tool, not a checker: exit 0
+    whenever the replay ran, violations or not."""
+    from madraft_tpu.tpusim.config import violation_names
+    from madraft_tpu.tpusim.trace import (
+        chrome_trace,
+        decode_events,
+        events_in_window,
+        replay_cluster_traced,
+    )
+
+    cfg = _sim_config(args)
+    final, rec = replay_cluster_traced(cfg, args.seed, args.cluster,
+                                       args.ticks)
+    events = decode_events(rec)
+    viol = int(final.violations)
+    fvt = int(final.first_violation_tick)
+    header = {
+        "seed": args.seed,
+        "cluster": args.cluster,
+        "ticks": args.ticks,
+        "violations": viol,
+        "violation_names": violation_names(viol),
+        "first_violation_tick": fvt,
+        "committed": int(final.shadow_len),
+        "events_total": len(events),
+    }
+    if args.format == "chrome":
+        doc = chrome_trace(
+            rec, cfg.ms_per_tick, events,
+            label=f"madtpu cluster {args.cluster} seed {args.seed}",
+        )
+        text = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+            header["trace_file"] = args.out
+            header["trace_events"] = len(doc["traceEvents"])
+            print(json.dumps(header))
+        else:
+            print(text)
+        return 0
+    shown = events_in_window(events, fvt if fvt >= 0 else None, args.window)
+    header["window"] = args.window
+    header["events_shown"] = len(shown)
+    print(json.dumps(header))
+    for e in shown:
+        print(json.dumps(e))
+    return 0
+
+
 def cmd_bridge(args):
     from madraft_tpu import bridge
+    from madraft_tpu.tpusim.config import violation_names
 
-    sched = bridge.extract_schedule(_sim_config(args), seed=args.seed,
+    cfg = _sim_config(args)
+    sched = bridge.extract_schedule(cfg, seed=args.seed,
                                     cluster_id=args.cluster, n_ticks=args.ticks)
     cpp = bridge.replay_on_simcore(sched)
     match = bridge.classes_match(sched.violations, cpp)
-    print(json.dumps({
+    out = {
         "tpu_violations": sched.violations,
+        "tpu_violation_names": violation_names(sched.violations),
         "cpp_report": cpp,
         "classes_match": match,
-    }))
+    }
+    if sched.violations and not match:
+        # boolean mismatch -> localized lead: replay both sides with the
+        # flight recorder on and report the first divergence tick
+        out["divergence"] = bridge.localize_divergence(
+            cfg, sched, args.seed, args.cluster, args.ticks
+        )
+    print(json.dumps(out))
     # failure = a TPU-found violation the C++ replay could NOT reproduce
     return 1 if (sched.violations and not match) else 0
 
@@ -528,6 +625,28 @@ def main(argv=None) -> int:
     common(sp, 1)
     sp.add_argument("--cluster", type=int, required=True)
     sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser(
+        "explain",
+        help="flight-recorder replay of ONE cluster: decoded event timeline "
+             "(JSONL) around the first violation, or a Perfetto export",
+    )
+    common(sp, 1)
+    sp.add_argument("--cluster", type=int, required=True)
+    sp.add_argument("--window", type=int, default=60,
+                    help="±ticks around first_violation_tick to print "
+                         "(<= 0 = the full timeline; violation events are "
+                         "always shown)")
+    sp.add_argument("--format", default="jsonl",
+                    choices=["jsonl", "chrome"],
+                    help="jsonl = header line + one event per line; chrome "
+                         "= Perfetto/chrome://tracing trace JSON (one track "
+                         "per node: role spans + instant events)")
+    sp.add_argument("--out", default="",
+                    help="with --format chrome: write the trace JSON to "
+                         "this file (a summary line goes to stdout) "
+                         "instead of dumping it to stdout")
+    sp.set_defaults(fn=cmd_explain)
 
     sp = sub.add_parser(
         "bridge", help="export a cluster's fault schedule and replay on C++"

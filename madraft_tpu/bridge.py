@@ -57,6 +57,8 @@ class Schedule:
     seed: int                         # simcore PRNG seed for the replay
     bug: str = ""                     # planted bug name ("" = correct;
     #                                   config.py RAFT_BUGS <-> MADTPU_BUG)
+    trace: bool = False               # per-tick C++ state export (the
+    #                                   flight-recorder leg; replay_core.h)
     # (tick, alive_bitmask) and (tick, adj row bitmasks) change events
     alive_events: list[tuple[int, int]] = dataclasses.field(default_factory=list)
     adj_events: list[tuple[int, list[int]]] = dataclasses.field(default_factory=list)
@@ -72,6 +74,8 @@ class Schedule:
             f"majority_override {self.majority_override}",
             f"seed {self.seed}",
         ]
+        if self.trace:
+            lines.append("trace 1")
         if self.bug:
             lines.insert(-1, f"bug {self.bug}")
         events = [(t, "alive", f"{m:x}") for t, m in self.alive_events] + [
@@ -350,6 +354,111 @@ def classes_match(tpu_violations: int, cpp_report: dict) -> bool:
     ):
         return True
     return False
+
+
+def _tick_summary(rec, tick: int) -> dict:
+    """Small human-readable snapshot of the TPU trace at a 1-based tick."""
+    ti = max(0, min(tick - 1, rec.role.shape[0] - 1))
+    from madraft_tpu.tpusim.config import LEADER
+
+    return {
+        "tick": ti + 1,
+        "alive": [bool(a) for a in rec.alive[ti]],
+        "leaders": [int(i) for i in np.nonzero(
+            rec.role[ti] == LEADER)[0]],
+        "terms": [int(x) for x in rec.term[ti]],
+        "commits": [int(x) for x in rec.commit[ti]],
+        "log_lens": [int(x) for x in rec.log_len[ti]],
+    }
+
+
+def _cpp_tick_summary(tr: dict, tick: int, n: int) -> dict:
+    ti = max(0, min(tick - 1, len(tr["alive"]) - 1))
+    return {
+        "tick": ti + 1,
+        "alive": [bool((tr["alive"][ti] >> i) & 1) for i in range(n)],
+        "leaders": [i for i in range(n) if (tr["leader"][ti] >> i) & 1],
+        "terms": tr["term"][ti],
+        "commits": tr["commit"][ti],
+        "log_lens": tr["len"][ti],
+    }
+
+
+def localize_divergence(
+    cfg: SimConfig,
+    sched: Schedule,
+    seed: int,
+    cluster_id: int,
+    n_ticks: int,
+    binary: Optional[pathlib.Path] = None,
+) -> dict:
+    """Turn a ``classes_match: false`` boolean into a localized lead: replay
+    BOTH sides with the flight recorder on and report the first tick where
+    the per-tick states diverge.
+
+    Two signals, strongest first:
+
+    - ``fault_schedule``: the per-tick ALIVE mask is schedule-determined and
+      must match EXACTLY across backends — a mismatch means the schedule
+      transport itself broke, at that tick. (Compared with a one-tick
+      persistence filter: a restart that lands within the same virtual
+      instant as the sample may lag one sample on the C++ side.)
+    - ``violation_onset``: the two backends draw from different PRNGs, so
+      per-tick raft state legitimately differs; what must still agree at
+      class level is WHETHER/WHEN a violation fires. The divergence tick is
+      the first tick where exactly one side is in violation; both sides'
+      state snapshots around it are attached as the debugging lead.
+    """
+    from madraft_tpu.tpusim.trace import alive_masks, replay_cluster_traced
+
+    _, rec = replay_cluster_traced(cfg, seed, cluster_id, n_ticks)
+    traced = dataclasses.replace(sched, trace=True)
+    cpp = replay_on_simcore(traced, binary=binary)
+    tr = cpp.get("trace")
+    if not tr or not tr["alive"]:
+        return {"error": "c++ replay returned no trace"}
+    n = cfg.n_nodes
+    tpu_alive = [int(m) for m in alive_masks(rec)]
+    T = min(len(tpu_alive), len(tr["alive"]))
+    for k in range(T - 1):
+        if (tpu_alive[k] != tr["alive"][k]
+                and tpu_alive[k + 1] != tr["alive"][k + 1]):
+            return {
+                "first_divergence_tick": k + 1,
+                "kind": "fault_schedule",
+                "tpu": _tick_summary(rec, k + 1),
+                "cpp": _cpp_tick_summary(tr, k + 1, n),
+            }
+    tpu_first = int(sched.first_violation_tick)
+    cpp_first = -1
+    if cpp["dual_leader"] or cpp["commit_mismatch"] or cpp["apply_disorder"]:
+        # ceil: a detection at ms in ((t-1)*mpt, t*mpt] happened DURING tick
+        # t (floor would report t-1 for any mid-tick detection — the C++
+        # checkers fire at apply/poll time, not on tick boundaries)
+        mpt = max(1, int(sched.ms_per_tick))
+        cpp_first = (int(cpp["first_violation_ms"]) + mpt - 1) // mpt
+    onsets = [t for t in (tpu_first, cpp_first) if t >= 0]
+    # ±1 tick tolerance: the C++ poll cadences quantize detection, so
+    # adjacent-tick onsets agree — but only if the violation CLASSES also
+    # correspond; same-time different-class is still a divergence (it is
+    # what made classes_match false in the first place)
+    near = (tpu_first >= 0 and cpp_first >= 0
+            and abs(tpu_first - cpp_first) <= 1)
+    if not onsets or (near and classes_match(sched.violations, cpp)):
+        return {
+            "first_divergence_tick": None,
+            "kind": None,
+            "note": "alive timelines match and violation onsets agree",
+        }
+    div = min(onsets)
+    return {
+        "first_divergence_tick": div,
+        "kind": "violation_class" if near else "violation_onset",
+        "tpu_first_violation_tick": tpu_first,
+        "cpp_first_violation_tick": cpp_first,
+        "tpu": _tick_summary(rec, div),
+        "cpp": _cpp_tick_summary(tr, div, n),
+    }
 
 
 # --------------------------------------------------------------- shardkv leg

@@ -257,6 +257,52 @@ VIOLATION_COMMIT_SHADOW = 4    # a committed entry changed or was lost (durabili
 VIOLATION_PREFIX_DIVERGE = 512  # equal snapshot boundaries, different compacted
 #                                 prefix hashes (durability beyond the window)
 
+# The ONE name table for every oracle bit across all layers — the shared
+# decoder every JSON report routes through (fuzz/replay/bridge/explain), so
+# no user ever again has to decode a raw bitmask by reading this file. The
+# service-layer bits are duplicated here by value on purpose: config.py is
+# imported by every layer, so it cannot import them back, and
+# tests/test_trace.py cross-checks each layer's VIOLATION_* constant against
+# this table so the duplication cannot silently drift.
+VIOLATION_NAMES = {
+    1: "DUAL_LEADER",          # config.py (election safety)
+    2: "LOG_MATCHING",         # config.py
+    4: "COMMIT_SHADOW",        # config.py (commit durability)
+    8: "EXACTLY_ONCE",         # kv.py
+    16: "KV_DIVERGE",          # kv.py
+    32: "STALE_READ",          # kv.py
+    64: "SHARD_DIVERGE",       # shardkv.py
+    128: "SHARD_OWNERSHIP",    # shardkv.py
+    256: "SHARD_STORAGE",      # shardkv.py
+    512: "PREFIX_DIVERGE",     # config.py (durability beyond the window)
+    1024: "SHARD_STALE_READ",  # shardkv.py
+    2048: "CTRL_DIVERGE",      # ctrler.py
+    4096: "CTRL_BALANCE",      # ctrler.py
+    8192: "CTRL_MINIMAL",      # ctrler.py
+    16384: "CTRL_QUERY",       # ctrler.py
+    32768: "SHARD_CTRL_STALE",  # shardkv.py (live-ctrler mode)
+}
+
+
+def violation_names(mask: int) -> list:
+    """Decode a violation bitmask into oracle names, lowest bit first.
+    Unknown set bits decode as ``BIT<k>`` rather than vanishing — a report
+    must never under-read a violation it cannot name."""
+    mask = int(mask)
+    names = [name for bit, name in sorted(VIOLATION_NAMES.items())
+             if mask & bit]
+    known = 0
+    for bit in VIOLATION_NAMES:
+        known |= bit
+    rest = mask & ~known
+    k = 0
+    while rest:
+        if rest & 1:
+            names.append(f"BIT{k}")
+        rest >>= 1
+        k += 1
+    return names
+
 # Role encoding.
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 

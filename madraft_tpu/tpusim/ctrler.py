@@ -80,6 +80,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
+from madraft_tpu.tpusim.engine import FuzzProgram
 from madraft_tpu.tpusim.state import (
     ClusterState,
     I32,
@@ -907,7 +908,10 @@ def make_ctrler_fuzz_fn(
     kn = cfg.knobs()
     ckn = kcfg.knobs()
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, ckn, ticks)
+    return FuzzProgram(
+        prog,
+        lambda seed: (jnp.asarray(seed, jnp.uint32), kn, ckn, ticks),
+    )
 
 
 def _validate_ctrler_knobs(ckn) -> None:
@@ -954,7 +958,10 @@ def make_ctrler_sweep_fn(
     kn = knobs.broadcast(n_clusters)
     ckn = cknobs.broadcast(n_clusters)
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, ckn, ticks)
+    return FuzzProgram(
+        prog,
+        lambda seed: (jnp.asarray(seed, jnp.uint32), kn, ckn, ticks),
+    )
 
 
 def ctrler_report(final: CtrlerState) -> CtrlerFuzzReport:

@@ -46,6 +46,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
+from madraft_tpu.tpusim.engine import FuzzProgram
 from madraft_tpu.tpusim.state import (
     ClusterState,
     I32,
@@ -778,7 +779,10 @@ def make_kv_fuzz_fn(
     kkn = kcfg.knobs()
     ticks = jnp.asarray(n_ticks, jnp.int32)
     # uint32 coercion: keep the (seed, cluster_id) replay contract under x64
-    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, kkn, ticks)
+    return FuzzProgram(
+        prog,
+        lambda seed: (jnp.asarray(seed, jnp.uint32), kn, kkn, ticks),
+    )
 
 
 def _validate_kv_knobs(kkn) -> None:
@@ -828,7 +832,10 @@ def make_kv_sweep_fn(
     kn = knobs.broadcast(n_clusters)
     kkn = kknobs.broadcast(n_clusters)
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, kkn, ticks)
+    return FuzzProgram(
+        prog,
+        lambda seed: (jnp.asarray(seed, jnp.uint32), kn, kkn, ticks),
+    )
 
 
 def kv_report(final: KvState) -> KvFuzzReport:

@@ -76,6 +76,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
 from madraft_tpu.tpusim.ctrler import _rebalance as _ctrl_rebalance
+from madraft_tpu.tpusim.engine import FuzzProgram
 from madraft_tpu.tpusim.state import (
     ClusterState,
     I32,
@@ -1896,7 +1897,10 @@ def make_shardkv_fuzz_fn(
     skn = kcfg.knobs()
     ticks = jnp.asarray(n_ticks, jnp.int32)
     # uint32 coercion: keep the (seed, cluster_id) replay contract under x64
-    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, skn, ticks)
+    return FuzzProgram(
+        prog,
+        lambda seed: (jnp.asarray(seed, jnp.uint32), kn, skn, ticks),
+    )
 
 
 def _validate_shardkv_knobs(skn) -> None:
@@ -1960,7 +1964,10 @@ def make_shardkv_sweep_fn(
     kn = knobs.broadcast(n_clusters)
     skn = sknobs.broadcast(n_clusters)
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return lambda seed: prog(jnp.asarray(seed, jnp.uint32), kn, skn, ticks)
+    return FuzzProgram(
+        prog,
+        lambda seed: (jnp.asarray(seed, jnp.uint32), kn, skn, ticks),
+    )
 
 
 def shardkv_report(final: ShardKvState) -> ShardKvFuzzReport:

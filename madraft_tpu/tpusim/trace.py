@@ -1,0 +1,344 @@
+"""Flight recorder: per-tick replay traces for one cluster (the ISSUE-2
+observability tentpole).
+
+The MADSIM_TEST_SEED replay contract reproduces any violating cluster from
+``(seed, cluster_id)`` — but ``engine.replay_cluster`` only returns the
+FINAL state, so a caught bug still had to be debugged blind. This module
+re-runs the same ``step_cluster`` inside a ``jax.lax.scan`` that emits a
+per-tick :class:`TickRecord` pytree, then host-decodes the stacked
+``[n_ticks, ...]`` arrays into structured events (leader elected, term
+bump, crash with suffix loss, partition change, snapshot install, commit
+advance, violation onset) — the counterexample-trace artifact the
+formal-verification line of related work (Raft in LNT / mCRL2) shows is
+what makes a checker usable.
+
+Deliberately a SEPARATE compiled program: the batched fuzz hot path is
+untouched (``engine._fuzz_program`` carries no trace outputs, and a fuzz
+report for a fixed seed stays bit-identical to pre-flight-recorder runs).
+The scan applies the identical ``step_cluster`` to the identical PRNG
+stream, so the traced final state is bit-identical to
+``engine.replay_cluster`` — asserted by tests/test_trace.py.
+
+Per-type delivery counts are derived EXACTLY without instrumenting the
+step: a mailbox slot due this tick (``stamp == t`` in the pre-tick state)
+is delivered iff its destination is alive and the link is up in the
+post-fault adjacency (both carried unchanged into the post-tick state),
+and ``step.pick_one`` delivers exactly one such source per destination —
+so ``sum_dst any_src(due & alive & adj)`` is the delivered count per type.
+The sum over types equals the tick's ``msg_count`` delta (cross-checked in
+tests).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madraft_tpu.tpusim.config import (
+    LEADER,
+    SimConfig,
+    violation_names,
+)
+from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
+from madraft_tpu.tpusim.step import step_cluster
+
+ROLE_NAMES = ("follower", "candidate", "leader")
+_DEAD = 3  # pseudo-role for Perfetto spans
+
+
+class TickRecord(NamedTuple):
+    """One tick's post-state snapshot plus that tick's deliveries.
+
+    Leaves are per-tick; ``replay_cluster_traced`` stacks them to a leading
+    ``[n_ticks]`` axis (numpy, host-side).
+    """
+
+    # --- per-node post-tick state [n] ---
+    role: jax.Array
+    term: jax.Array
+    commit: jax.Array
+    log_len: jax.Array
+    base: jax.Array            # snapshot boundary
+    durable_len: jax.Array     # fsync watermark
+    alive: jax.Array           # bool
+    adj_mask: jax.Array        # i32 row bitmask: bit s of row d = link s->d up
+    # --- exact per-type delivery counts this tick (i32 scalars) ---
+    rv_req_delivered: jax.Array
+    rv_rsp_delivered: jax.Array
+    ae_req_delivered: jax.Array
+    ae_rsp_delivered: jax.Array
+    snap_delivered: jax.Array
+    # --- install-snapshot outcomes [n] ---
+    snap_installed_src: jax.Array   # -1 = none this tick
+    snap_installed_len: jax.Array
+    # --- cluster-wide scalars ---
+    shadow_len: jax.Array      # committed entries ever (durability shadow)
+    msg_count: jax.Array       # cumulative delivered messages
+    violations: jax.Array      # sticky oracle bitmask
+
+
+def _pack_rows(mat: jax.Array) -> jax.Array:
+    """[n, n] bool -> [n] i32 row bitmasks (bit j of row i = mat[i, j])."""
+    n = mat.shape[-1]
+    w = jnp.left_shift(jnp.asarray(1, I32), jnp.arange(n, dtype=I32))
+    return jnp.sum(jnp.where(mat, w[None, :], 0), axis=-1).astype(I32)
+
+
+def _deliveries(prev: ClusterState, nxt: ClusterState):
+    """Exact per-type delivered counts for the tick prev -> nxt (see module
+    docstring for why this is exact without touching step_cluster)."""
+    t = nxt.tick
+    alive, adj = nxt.alive, nxt.adj
+
+    def cnt(mail_t, extra_ok=None):
+        ok = (mail_t == t) & alive[:, None] & adj
+        if extra_ok is not None:
+            ok = ok & extra_ok
+        return jnp.sum(jnp.any(ok, axis=1), dtype=I32)
+
+    return (
+        cnt(prev.rv_req_t),
+        cnt(prev.rv_rsp_t),
+        cnt(prev.ae_req_t),
+        cnt(prev.ae_rsp_t),
+        # install-snapshot delivery additionally needs a live SENDER
+        # (read-at-delivery payload; step.py sn pick_one extra_ok)
+        cnt(prev.sn_req_t, extra_ok=alive[None, :]),
+    )
+
+
+def _record(prev: ClusterState, nxt: ClusterState) -> TickRecord:
+    rv_req, rv_rsp, ae_req, ae_rsp, sn = _deliveries(prev, nxt)
+    return TickRecord(
+        role=nxt.role, term=nxt.term, commit=nxt.commit,
+        log_len=nxt.log_len, base=nxt.base, durable_len=nxt.durable_len,
+        alive=nxt.alive, adj_mask=_pack_rows(nxt.adj),
+        rv_req_delivered=rv_req, rv_rsp_delivered=rv_rsp,
+        ae_req_delivered=ae_req, ae_rsp_delivered=ae_rsp, snap_delivered=sn,
+        snap_installed_src=nxt.snap_installed_src,
+        snap_installed_len=nxt.snap_installed_len,
+        shadow_len=nxt.shadow_len, msg_count=nxt.msg_count,
+        violations=nxt.violations,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _traced_program(static_cfg: SimConfig, n_ticks: int):
+    """One compiled traced-replay program per (static shape, tick count).
+    The scan length must be static (it shapes the stacked outputs), so
+    n_ticks joins the cache key — fine for single-cluster replay."""
+
+    def run(cluster_id, kn, seed):
+        ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
+        state0 = init_cluster(static_cfg, ckey, kn)
+
+        def body(carry, _):
+            nxt = step_cluster(static_cfg, carry, ckey, kn)
+            return nxt, _record(carry, nxt)
+
+        return jax.lax.scan(body, state0, None, length=n_ticks)
+
+    return jax.jit(run)
+
+
+def replay_cluster_traced(
+    cfg: SimConfig, seed: int, cluster_id: int, n_ticks: int
+):
+    """Re-run ONE cluster with the flight recorder on.
+
+    Returns ``(final_state, trace)``: the final :class:`ClusterState`
+    (bit-identical to ``engine.replay_cluster`` — same step, same PRNG
+    stream) and a :class:`TickRecord` of host numpy arrays with a leading
+    ``[n_ticks]`` axis.
+    """
+    prog = _traced_program(cfg.static_key(), int(n_ticks))
+    final, rec = jax.block_until_ready(
+        prog(jnp.asarray(cluster_id, I32), cfg.knobs(),
+             jnp.asarray(seed, jnp.uint32))
+    )
+    return final, jax.tree.map(np.asarray, rec)
+
+
+# --------------------------------------------------------------- host decode
+def alive_masks(rec: TickRecord) -> np.ndarray:
+    """[T] int alive bitmask per tick (bit i = node i alive) — the
+    schedule-invariant signal the bridge compares across backends."""
+    n = rec.alive.shape[1]
+    return (rec.alive.astype(np.int64) << np.arange(n)).sum(axis=1)
+
+
+def decode_events(rec: TickRecord) -> list:
+    """Stacked per-tick arrays -> structured event timeline.
+
+    Every event is a dict with at least ``tick`` (1-based, matching
+    ``first_violation_tick``) and ``event``; ticks with nothing notable
+    emit nothing, so a 600-tick trace decodes to a readable timeline.
+    """
+    T, n = rec.role.shape
+    events = []
+    # tick-0 baseline = init_cluster: all followers, term 0, alive, fully
+    # connected, empty logs, no commits, no violations
+    prev_role = np.zeros(n, np.int64)
+    prev_term = np.zeros(n, np.int64)
+    prev_alive = np.ones(n, bool)
+    prev_adj = np.full(n, (1 << n) - 1, np.int64)
+    prev_len = np.zeros(n, np.int64)
+    prev_shadow = 0
+    prev_viol = 0
+    for ti in range(T):
+        t = ti + 1
+        role = rec.role[ti]
+        term = rec.term[ti]
+        alive = rec.alive[ti]
+        adj = rec.adj_mask[ti]
+        llen = rec.log_len[ti]
+        for i in range(n):
+            if prev_alive[i] and not alive[i]:
+                lost = int(prev_len[i] - llen[i])
+                ev = {"tick": t, "event": "crash", "node": i}
+                if lost > 0:  # un-fsynced suffix dropped (durability axis)
+                    ev["lost_suffix"] = lost
+                events.append(ev)
+            elif alive[i] and not prev_alive[i]:
+                events.append({"tick": t, "event": "restart", "node": i,
+                               "term": int(term[i])})
+        if (adj != prev_adj).any():
+            events.append({
+                "tick": t, "event": "partition_change",
+                "adj_rows": [int(r) for r in adj],
+            })
+        for i in range(n):
+            if term[i] > prev_term[i] and alive[i]:
+                events.append({
+                    "tick": t, "event": "term_bump", "node": i,
+                    "term": int(term[i]),
+                    "role": ROLE_NAMES[int(role[i])],
+                })
+            if role[i] == LEADER and prev_role[i] != LEADER:
+                events.append({
+                    "tick": t, "event": "leader_elected", "node": i,
+                    "term": int(term[i]),
+                })
+            elif prev_role[i] == LEADER and role[i] != LEADER and alive[i]:
+                events.append({
+                    "tick": t, "event": "step_down", "node": i,
+                    "term": int(term[i]),
+                })
+        for i in range(n):
+            src = int(rec.snap_installed_src[ti][i])
+            if src >= 0:
+                events.append({
+                    "tick": t, "event": "snapshot_install", "node": i,
+                    "from": src,
+                    "boundary": int(rec.snap_installed_len[ti][i]),
+                })
+        shadow = int(rec.shadow_len[ti])
+        if shadow > prev_shadow:
+            events.append({
+                "tick": t, "event": "commit_advance",
+                "committed": shadow, "delta": shadow - prev_shadow,
+            })
+        viol = int(rec.violations[ti])
+        new_bits = viol & ~prev_viol
+        if new_bits:
+            events.append({
+                "tick": t, "event": "violation",
+                "first": prev_viol == 0,
+                "new_bits": new_bits,
+                "names": violation_names(new_bits),
+            })
+        prev_role, prev_term, prev_alive = role, term, alive
+        prev_adj, prev_len = adj, llen
+        prev_shadow, prev_viol = shadow, viol
+    return events
+
+
+def events_in_window(
+    events: list, center: Optional[int], window: int
+) -> list:
+    """Events within ``window`` ticks of ``center`` — violation events are
+    always kept (they are the reason the user is here). ``window <= 0`` or
+    no center (no violation found) returns the full timeline."""
+    if center is None or center < 0 or window <= 0:
+        return events
+    return [
+        e for e in events
+        if abs(e["tick"] - center) <= window or e["event"] == "violation"
+    ]
+
+
+# ------------------------------------------------------------ Perfetto export
+def chrome_trace(
+    rec: TickRecord,
+    ms_per_tick: int,
+    events: Optional[list] = None,
+    label: str = "cluster",
+) -> dict:
+    """Chrome/Perfetto trace-event JSON for one traced replay: one track
+    (tid) per node with role spans (follower/candidate/leader/dead),
+    instant events for the decoded timeline, and counter tracks for commit
+    progress and per-tick deliveries. Load in ui.perfetto.dev or
+    chrome://tracing."""
+    if events is None:
+        events = decode_events(rec)
+    T, n = rec.role.shape
+    us = float(ms_per_tick) * 1000.0  # ts unit is microseconds
+    out = [{"name": "process_name", "ph": "M", "pid": 0,
+            "args": {"name": label}}]
+    for i in range(n):
+        out.append({"name": "thread_name", "ph": "M", "pid": 0, "tid": i,
+                    "args": {"name": f"node {i}"}})
+    # role spans (complete "X" events over contiguous (role|dead) runs)
+    states = np.where(rec.alive, rec.role, _DEAD)  # [T, n]
+    for i in range(n):
+        start = 0
+        for ti in range(1, T + 1):
+            if ti < T and states[ti, i] == states[start, i] \
+                    and (states[start, i] != LEADER
+                         or rec.term[ti, i] == rec.term[start, i]):
+                continue
+            sid = int(states[start, i])
+            name = "dead" if sid == _DEAD else ROLE_NAMES[sid]
+            if sid == LEADER:
+                name = f"leader t{int(rec.term[start, i])}"
+            out.append({
+                "name": name, "ph": "X", "pid": 0, "tid": i,
+                "ts": (start + 1) * us, "dur": (ti - start) * us,
+                "args": {"term": int(rec.term[start, i]),
+                         "commit": int(rec.commit[start, i])},
+            })
+            start = ti
+    # instant events from the decoded timeline
+    for e in events:
+        ts = e["tick"] * us
+        args = {k: v for k, v in e.items() if k not in ("tick", "event")}
+        if "node" in e:
+            out.append({"name": e["event"], "ph": "i", "s": "t", "pid": 0,
+                        "tid": e["node"], "ts": ts, "args": args})
+        elif e["event"] in ("partition_change", "violation"):
+            out.append({"name": e["event"], "ph": "i", "s": "p", "pid": 0,
+                        "tid": 0, "ts": ts, "args": args})
+    # counters: commit progress and message deliveries per tick
+    prev_shadow = -1
+    for ti in range(T):
+        ts = (ti + 1) * us
+        shadow = int(rec.shadow_len[ti])
+        if shadow != prev_shadow:
+            out.append({"name": "committed", "ph": "C", "pid": 0, "ts": ts,
+                        "args": {"committed": shadow}})
+            prev_shadow = shadow
+        out.append({
+            "name": "deliveries", "ph": "C", "pid": 0, "ts": ts,
+            "args": {
+                "rv_req": int(rec.rv_req_delivered[ti]),
+                "rv_rsp": int(rec.rv_rsp_delivered[ti]),
+                "ae_req": int(rec.ae_req_delivered[ti]),
+                "ae_rsp": int(rec.ae_rsp_delivered[ti]),
+                "snap": int(rec.snap_delivered[ti]),
+            },
+        })
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
