@@ -13,9 +13,14 @@ Methodology (round-3; see PERF.md for the batch-size sweep and phase budget):
   batches are slower per step (HBM working-set pressure: 8k -> 16.1M,
   16k -> 13.2M, 64k -> 8.3M steps/s in the round-3 sweep), smaller ones
   under-fill the chip.
-- The tick scan is chunked (host loop over compiled CHUNK-tick scans) so a
-  single device execution stays well under the tunnel's per-call deadline;
-  chunk inputs are donated so the state double-buffer is reused.
+- The tick scan is chunked (host loop over compiled CHUNK-tick programs) so
+  a single device execution stays well under the tunnel's per-call deadline;
+  chunk inputs are donated so the state double-buffer is reused. The chunk
+  runner is engine.make_chunked_fuzz_fn — ONE implementation shared with the
+  CLI and the continuous pool (the hand-rolled duplicate with compile-time-
+  baked knobs is deleted; runtime scalar knobs measured ~6% slower than
+  baked constants, see PERF.md's knob-layout table — what is timed now is
+  the path users actually run).
 - Each timed region is whole runs repeated until >=1 s of wall time (at
   least 2 runs); the reported value is the best run, with the spread across
   runs so back-to-back agreement is visible.
@@ -25,7 +30,7 @@ Methodology (round-3; see PERF.md for the batch-size sweep and phase budget):
   step function runs.
 - compile_s per region: the service regions measure it directly via the
   FuzzProgram AOT split (the same mechanism behind the CLI fuzz telemetry);
-  the raft region's hand-rolled chunked jit uses the cold-call-minus-best
+  the raft region's host-looped chunk dispatch uses the cold-call-minus-best
   estimate — either way compile-time regressions are visible in BENCH
   artifacts, not only execution throughput.
 - kv / shardkv rows time the full service stacks (clerks, apply machines,
@@ -34,21 +39,18 @@ Methodology (round-3; see PERF.md for the batch-size sweep and phase budget):
   raw raft tick (round-2 verdict item).
 """
 
-import functools
 import json
 import sys
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from madraft_tpu.tpusim import SimConfig, init_cluster, step_cluster
-from madraft_tpu.tpusim.engine import report
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.engine import make_chunked_fuzz_fn, report, run_pool
 
 BASELINE_STEPS_PER_SEC = 100_000.0  # BASELINE.json north star
 HBM_PEAK_BYTES_PER_S = 819e9        # TPU v5e; proxy denominator only
-CHUNK_TICKS = 256                   # one device execution = one chunk
 
 
 def flagship_config() -> SimConfig:
@@ -92,7 +94,7 @@ def _warmed(run, sync):
 def _compile_s(cold_s: float, best_s: float) -> float:
     """Compile-time estimate: first-call wall minus the best steady-state
     run (the execution share of the cold call); floored at 0 for noise.
-    bench_raft's hand-rolled chunked jit has no AOT handle, so it is the
+    bench_raft's host-looped chunk dispatch has no AOT handle, so it is the
     one region that uses this estimate; the service regions measure compile
     directly (_compile_region)."""
     return round(max(0.0, cold_s - best_s), 3)
@@ -112,40 +114,15 @@ def _compile_region(fn, sync):
 
 
 def bench_raft(n_clusters: int, n_ticks: int, cfg: SimConfig) -> dict:
-    @jax.jit
-    def init(seed):
-        base = jax.random.PRNGKey(seed)
-        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-            jnp.arange(n_clusters)
-        )
-        return jax.vmap(functools.partial(init_cluster, cfg))(keys), keys
-
-    def make_chunk(length):
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def chunk(states, keys):
-            def body(c, _):
-                return (
-                    jax.vmap(functools.partial(step_cluster, cfg))(c, keys),
-                    None,
-                )
-
-            final, _ = jax.lax.scan(body, states, None, length=length)
-            return final
-
-        return chunk
-
-    # exact tick count: floor chunks of CHUNK_TICKS plus one remainder chunk
-    n_chunks, rem = divmod(n_ticks, CHUNK_TICKS)
-    chunks = [make_chunk(CHUNK_TICKS)] * n_chunks
-    if rem or not chunks:
-        chunks.append(make_chunk(rem or n_ticks))
+    # the engine's donated chunked dispatch (one implementation for
+    # bench/CLI/pool — the hand-rolled duplicate with compile-time-baked
+    # knobs is gone; this times the runtime-scalar-knob path users actually
+    # run, measured ~6% below baked constants, see PERF.md knob-layout table)
+    run_fn = make_chunked_fuzz_fn(cfg, n_clusters, n_ticks)
     ticks = n_ticks
 
     def run(seed=12345):
-        states, keys = init(jnp.asarray(seed, jnp.uint32))
-        for chunk in chunks:
-            states = chunk(states, keys)
-        return states
+        return run_fn(seed)
 
     cold_s, final = _warmed(run, lambda s: np.asarray(s.violations))
     state_bytes = sum(x.nbytes for x in jax.tree.leaves(final))
@@ -252,6 +229,71 @@ def bench_shardkv(n_deployments: int, n_ticks: int,
     }
 
 
+def bench_pool(n_lanes: int, budget_ticks: int) -> dict:
+    """Continuous-batching A/B on the planted-bug durability profile:
+    violations per chip-second, fixed-batch fixed-horizon driver vs the
+    retire-and-refill pool, SAME batch and SAME tick budget.
+
+    The fixed driver's only way to spend the budget at a fixed batch is one
+    run with horizon = budget — its population ages into low-hazard
+    survivors (sticky violators burn ticks to the end, and a cluster that
+    has stayed clean for thousands of ticks violates more rarely than a
+    fresh one). The pool instead retires at the profile's demonstrated
+    600-tick horizon (violated lanes at the next chunk boundary) and
+    refills with fresh clusters under new global ids. Both legs are single
+    timed runs (they are long); see PERF.md for the run-spread caveat."""
+    from madraft_tpu.tpusim.config import storm_profiles
+
+    from madraft_tpu.tpusim.engine import default_chunk_ticks
+
+    prof, _, rec_ticks, _bugs = storm_profiles()["durability"]
+    cfg = prof.replace(bug="ack_before_fsync")
+    horizon = min(rec_ticks, budget_ticks)
+    chunk = default_chunk_ticks(horizon)  # run_pool's own default rule
+    sync = lambda s: np.asarray(s.violations)  # noqa: E731
+
+    fuzz_fn = make_chunked_fuzz_fn(cfg, n_lanes, budget_ticks)
+    # warm with ONE chunk, not a full budget run: the chunk program's tick
+    # count is a runtime bound, so this compiles the identical executables
+    _warmed(lambda: make_chunked_fuzz_fn(cfg, n_lanes, chunk)(12345), sync)
+    t0 = time.perf_counter()
+    final = fuzz_fn(12345)
+    sync(final)
+    fuzz_wall = time.perf_counter() - t0
+    rep = report(final)
+    fuzz_viol = int((rep.violations != 0).sum())
+
+    # run_pool warms its own programs outside its timed window (harvest
+    # included), so one call is the timed full-budget run
+    summary = run_pool(cfg, 12345, n_lanes, horizon,
+                       chunk_ticks=chunk, budget_ticks=budget_ticks)
+    pool_wall = summary["wall_s"]
+    pool_viol = summary["retired_violating"]
+    fuzz_vps = fuzz_viol / fuzz_wall if fuzz_wall > 0 else 0.0
+    pool_vps = pool_viol / pool_wall if pool_wall > 0 else 0.0
+    return {
+        "profile": "durability",
+        "bug": "ack_before_fsync",
+        "lanes": n_lanes,
+        "budget_ticks": budget_ticks,
+        "horizon": horizon,
+        "chunk_ticks": chunk,
+        "fuzz_violations": fuzz_viol,
+        "fuzz_wall_s": round(fuzz_wall, 3),
+        "fuzz_viol_per_chip_s": round(fuzz_vps, 4),
+        "fuzz_steps_per_sec": round(n_lanes * budget_ticks / fuzz_wall, 1),
+        "pool_violations": pool_viol,
+        "pool_retired": summary["retired"],
+        "pool_wall_s": pool_wall,
+        "pool_viol_per_chip_s": round(pool_vps, 4),
+        "pool_steps_per_sec": summary["steps_per_sec"],
+        "pool_effective_steps_per_sec": summary["effective_steps_per_sec"],
+        "viol_per_chip_s_ratio": (
+            round(pool_vps / fuzz_vps, 3) if fuzz_vps else None
+        ),
+    }
+
+
 def main() -> None:
     # MADTPU_BENCH_PLATFORM=cpu forces the CPU backend (ci.sh fallback when
     # no healthy accelerator is attached); must run before backend init.
@@ -290,6 +332,11 @@ def main() -> None:
     # rebalance at the ctrl walker + map-adoption apply path) as its own row
     skvc = bench_shardkv(max(64, n_clusters // 16), max(128, n_ticks // 4),
                          computed_ctrler=True)
+    # continuous-batching A/B: the fixed driver's waste (sticky violators
+    # ticking to the horizon) grows with the budget — >= 20 durability
+    # horizons makes it first-order (PERF.md round 6); smokes keep a small
+    # budget so the row stays cheap on CPU
+    pool = bench_pool(max(64, n_clusters // 16), max(2400, 12 * n_ticks))
     steps_per_sec = raft.pop("steps_per_sec")
     print(
         json.dumps(
@@ -324,6 +371,10 @@ def main() -> None:
                         "cluster_steps_per_sec"
                     ),
                     "shardkv_computed_ctrler": skvc,
+                    "pool_viol_per_chip_s_ratio": pool[
+                        "viol_per_chip_s_ratio"
+                    ],
+                    "pool": pool,
                     "device": str(jax.devices()[0]),
                     **({"degraded": degraded} if degraded else {}),
                 },

@@ -91,6 +91,38 @@ print(f"explain smoke: {len(lines) - 1} events, "
       "fixed-seed fuzz golden OK")
 PY
 
+# pool smoke (ISSUE 5): the continuous retire-and-refill pool on the
+# durability profile. The planted-bug leg must retire >= 1 violating
+# cluster within its budget and exit 1 (violations are findings, like
+# fuzz); the clean leg must retire everything at the horizon and exit 0.
+MADTPU_PLATFORM=cpu python - <<'PY'
+import contextlib, io, json
+from madraft_tpu.__main__ import main
+
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["pool", "--profile", "durability", "--bug", "ack_before_fsync",
+               "--clusters", "64", "--ticks", "300", "--chunk-ticks", "100",
+               "--budget-ticks", "600", "--seed", "1"])
+lines = [json.loads(x) for x in buf.getvalue().strip().splitlines()]
+summary = lines[-1]
+assert rc == 1, f"pool bug leg exit {rc} != 1"
+assert summary["retired_violating"] >= 1, summary
+rows = [r for r in lines[:-1] if r.get("violations")]
+assert rows and rows[0]["cluster_id"] in summary["violating_clusters"], rows
+
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["pool", "--profile", "durability", "--clusters", "64",
+               "--ticks", "300", "--chunk-ticks", "100",
+               "--budget-ticks", "300", "--seed", "12345"])
+summary = json.loads(buf.getvalue().strip().splitlines()[-1])
+assert rc == 0, f"pool clean leg exit {rc} != 0"
+assert summary["retired_violating"] == 0 and summary["retired"] == 64, summary
+print(f"pool smoke: bug leg retired {len(rows)} violating "
+      f"(first={rows[0]['cluster_id']}), clean leg 64/64 at horizon")
+PY
+
 echo "== [5/5] bench smoke (1024 clusters x 128 ticks)"
 # prefer the attached accelerator; fall back to CPU if it is absent or hung
 timeout 600 python bench.py 1024 128 \

@@ -2,6 +2,7 @@
 (SURVEY.md §7 architecture item 4's "CLI" deliverable).
 
     python -m madraft_tpu fuzz        --clusters 4096 --ticks 1024 [--storm]
+    python -m madraft_tpu pool        --clusters 4096 --ticks 600 --budget-ticks 4800
     python -m madraft_tpu kv-fuzz     --clusters 512  --ticks 512
     python -m madraft_tpu ctrler-fuzz --clusters 512  --ticks 512
     python -m madraft_tpu shardkv-fuzz --clusters 64  --ticks 640
@@ -207,6 +208,41 @@ def cmd_fuzz(args):
     return _finish_fuzz(args, fn, report)
 
 
+def cmd_pool(args):
+    """Continuous fuzzing pool (retire-and-refill): --clusters lanes stay
+    resident on device; a lane retires when its cluster violated or reached
+    the --ticks horizon and is refilled with a fresh cluster under the next
+    global id — the (seed, cluster_id) replay contract survives arbitrarily
+    many refills, so any streamed hit replays/explains exactly like a fuzz
+    hit. Streams one JSONL line per retired cluster (with the running
+    violations/s), then a summary line; exit 1 iff a violation was found."""
+    import jax
+
+    from madraft_tpu.tpusim.engine import run_pool
+
+    cfg = _sim_config(args)
+    budget_ticks = args.budget_ticks if args.budget_ticks > 0 else None
+    budget_seconds = args.budget_seconds if args.budget_seconds > 0 else None
+    emit_all = args.emit == "all"
+
+    def on_retired(row):
+        if emit_all or row["violations"]:
+            print(json.dumps(row), flush=True)
+
+    summary = run_pool(
+        cfg, args.seed, args.clusters, args.ticks,
+        chunk_ticks=args.chunk_ticks, budget_ticks=budget_ticks,
+        budget_seconds=budget_seconds, mesh=_mesh(args),
+        on_retired=on_retired,
+    )
+    dev = jax.devices()[0]
+    summary.update(
+        {"seed": args.seed, "device": str(dev), "backend": dev.platform}
+    )
+    print(json.dumps(summary))
+    return 1 if summary["retired_violating"] else 0
+
+
 def _service_bugs(cfg_cls) -> set:
     """The layer's planted-bug names, derived from its config dataclass's
     bug_* fields — one source of truth, so a new bug knob is automatically
@@ -394,6 +430,9 @@ def cmd_sweep(args):
         # n rounds --clusters DOWN to a multiple of the cell count — surface
         # it so coverage accounting never silently over-reads
         "clusters_run": n,
+        # which knob layout ran: "uniform" (small grid -> per-cell fast
+        # programs) or "per_cluster" (one heterogeneous-knob program)
+        "dispatch": getattr(fn, "dispatch", "per_cluster"),
         "cells": cells,
         "seed": args.seed,
         **extra,
@@ -572,6 +611,35 @@ def main(argv=None) -> int:
     fuzz_common(sp, 4096)
     sp.set_defaults(fn=cmd_fuzz)
 
+    sp = sub.add_parser(
+        "pool",
+        help="continuous fuzzing pool: retire violated/horizon-reached "
+             "clusters on device and refill their lanes with fresh ones "
+             "under new global ids (--ticks is the per-cluster horizon); "
+             "streams retired-cluster reports as JSONL + a summary line",
+    )
+    common(sp, 4096)
+    sp.add_argument("--mesh", action="store_true",
+                    help="shard the lane batch over ALL attached devices")
+    sp.add_argument("--chunk-ticks", type=int, default=0,
+                    help="ticks per compiled chunk between harvests (0 = "
+                         "the horizon split into equal chunks of at most "
+                         "256 ticks, so lanes retire exactly at the "
+                         "horizon); retirement is detected at chunk "
+                         "boundaries, so a retired cluster's ticks_run is "
+                         "a multiple of this")
+    sp.add_argument("--budget-ticks", type=int, default=0,
+                    help="stop once every lane has dispatched this many "
+                         "ticks, rounded up to whole chunks (0 = unset; "
+                         "with --budget-seconds also unset, one horizon)")
+    sp.add_argument("--budget-seconds", type=float, default=0.0,
+                    help="stop at the first harvest past this wall-clock "
+                         "budget (0 = unset)")
+    sp.add_argument("--emit", default="all", choices=["all", "violations"],
+                    help="stream every retired-cluster report, or only "
+                         "violating ones")
+    sp.set_defaults(fn=cmd_pool)
+
     sp = sub.add_parser("kv-fuzz", help="KV service fuzz (Lab 3)")
     service_common(sp, 512)
     sp.add_argument("--p-get", type=float, default=0.3)
@@ -661,8 +729,16 @@ def main(argv=None) -> int:
     # startup hook force-registers the tunnel regardless of the env var),
     # and fails fast with an actionable message — instead of hanging
     # indefinitely inside PJRT init — when the tunnel is degraded.
-    from madraft_tpu._platform import require_backend_or_die
+    from madraft_tpu._platform import (
+        enable_compilation_cache,
+        require_backend_or_die,
+    )
 
+    # Persistent XLA compilation cache (same knobs as tests/conftest.py):
+    # a cold CLI run reuses every program the test suite — or any earlier
+    # run — already compiled, instead of recompiling it. MADTPU_CACHE_DIR
+    # overrides the location ("0" disables).
+    enable_compilation_cache()
     require_backend_or_die(args.platform)
     return args.fn(args)
 

@@ -73,6 +73,34 @@ def _record_probe(plat, ok: bool, latency_s: float, detail: str) -> None:
         pass  # a read-only checkout must not break the probe itself
 
 
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Persistent XLA compilation cache — ONE configuration shared by the
+    test suite (tests/conftest.py) and the CLI entry point, so a cold CLI
+    run reuses every program the suite (or a previous run) already
+    compiled instead of recompiling it. Explicit config is required — the
+    cache directory merely existing is not enough (round-1 mistake).
+
+    ``cache_dir=None`` resolves MADTPU_CACHE_DIR (a path, or "0" to
+    disable) and falls back to ``<repo root>/.jax_cache`` — the same
+    directory conftest.py points at. Returns the directory used, or None
+    when disabled."""
+    if cache_dir is None:
+        cache_dir = os.environ.get("MADTPU_CACHE_DIR", "")
+        if cache_dir == "0":
+            return None
+        if not cache_dir:
+            cache_dir = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache",
+            )
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir
+
+
 def resolve_platform(explicit: str | None = None) -> str | None:
     """The platform the user asked for, or None for 'whatever the
     environment provides' (on this container: the axon tunnel).

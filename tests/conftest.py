@@ -36,14 +36,11 @@ if not _ON_TPU:
 
 # Persistent compilation cache: the suite is compile-dominated (many distinct
 # (config, shape) step programs); with the cache warm a full run saves minutes
-# of compile. Explicit config — the cache directory merely existing is not
-# enough (round-1 mistake).
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# of compile. One shared configuration (madraft_tpu._platform) — the CLI
+# entry point enables the same cache, so suite and CLI runs feed each other.
+from madraft_tpu._platform import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache(os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
 # CAUTION: XLA's executable.serialize() SEGFAULTS on this container for the
 # largest mesh-sharded shardkv executable (jax compilation_cache
 # put_executable_and_time, reproduced 4x in round 5 — localized by the

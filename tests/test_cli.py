@@ -120,6 +120,49 @@ def test_cli_sweep_grid():
             run(["sweep", "--clusters", "60", "--ticks", "16", "--mesh"])
 
 
+def test_cli_pool_streams_and_exit_codes():
+    # the continuous-pool verb: one JSONL row per retired cluster (with the
+    # running violations/s) + a summary line; exit 1 iff a violation retired
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["pool", "--clusters", "16", "--ticks", "64",
+                   "--chunk-ticks", "32", "--budget-ticks", "128",
+                   "--storm", "--majority-override", "2", "--seed", "7"])
+    lines = [json.loads(x) for x in buf.getvalue().strip().splitlines()]
+    rows, summary = lines[:-1], lines[-1]
+    assert rc == 1 and summary["retired_violating"] > 0, summary
+    assert summary["retired"] == len(rows)
+    assert summary["violating_clusters"], summary
+    viol = [r for r in rows if r["violations"]]
+    assert viol and viol[0]["violation_names"] == ["DUAL_LEADER"]
+    assert viol[-1]["violations_per_s"] is not None
+    # a retired row's coordinates feed replay directly (the workflow the
+    # README documents: pool -> explain -> replay)
+    r = viol[0]
+    rc2, out = run(["replay", "--cluster", str(r["cluster_id"]),
+                    "--ticks", str(r["ticks_run"]), "--storm",
+                    "--majority-override", "2", "--seed", "7"])
+    assert rc2 == 1 and out["violations"] == r["violations"], (r, out)
+
+    # clean profile: everything retires at the horizon, exit 0
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["pool", "--clusters", "16", "--ticks", "64",
+                   "--budget-ticks", "64", "--storm", "--seed", "3"])
+    summary = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rc == 0 and summary["retired_violating"] == 0, summary
+    assert summary["retired"] == 16
+
+
+def test_cli_sweep_small_grid_uniform_dispatch():
+    # a small grid rides the fast uniform-knob layout (per-cell programs)
+    # and says so; cell accounting is unchanged
+    rc, out = run(["sweep", "--clusters", "16", "--ticks", "64",
+                   "--loss", "0,0.1", "--crash", "0", "--repartition", "0"])
+    assert rc == 0 and out["dispatch"] == "uniform", out
+    assert len(out["cells"]) == 2 and out["clusters_run"] == 16
+
+
 def test_cli_service_bug_flag():
     # the planted-bug library from the front door: each layer's bug fires
     # (exit 1 + violations) and unknown names / wrong verbs are rejected

@@ -1,0 +1,203 @@
+"""The continuous fuzzing pool (retire-and-refill) and the uniform sweep
+dispatch: the first pool generation is bit-identical to straight fuzz, every
+pool hit replays bit-exactly via (seed, global_cluster_id) across refill
+generations, the chunk carry is donated, pool hits explain like fuzz hits,
+and a small-grid sweep's uniform dispatch matches the per-cluster layout."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.engine import (
+    fuzz,
+    make_sweep_fn,
+    replay_cluster,
+    report,
+    run_pool,
+)
+
+STORM = SimConfig(
+    n_nodes=5, p_client_cmd=0.2, loss_prob=0.1, p_crash=0.01, p_restart=0.2,
+    max_dead=2, p_repartition=0.02, p_heal=0.05,
+)
+# dual-leader demo config: violations land early and often, so a tiny pool
+# run retires violating clusters across several refill generations
+VIOL = STORM.replace(majority_override=2)
+
+_POOL_CACHE = {}
+
+
+def _pooled(cfg, seed, n, horizon, chunk, budget):
+    """Run the pool once per distinct argument tuple (results are pure
+    functions of the arguments — determinism is itself under test via the
+    replay assertions)."""
+    key = (cfg, seed, n, horizon, chunk, budget)
+    if key not in _POOL_CACHE:
+        rows = []
+        summary = run_pool(cfg, seed, n, horizon, chunk_ticks=chunk,
+                           budget_ticks=budget, on_retired=rows.append)
+        _POOL_CACHE[key] = (rows, summary)
+    return _POOL_CACHE[key]
+
+
+def test_pool_first_generation_bit_identical_to_fuzz():
+    # horizon == chunk == budget: exactly one chunk + one harvest, so every
+    # lane retires with the state straight fuzz would have produced — all
+    # report fields must match bit-exactly (the golden-guard property on
+    # the pool path)
+    rep = fuzz(STORM, 12345, 16, 96)
+    rows, summary = _pooled(STORM, 12345, 16, 96, 96, 96)
+    assert summary["retired"] == 16
+    assert sorted(r["cluster_id"] for r in rows) == list(range(16))
+    for r in rows:
+        c = r["cluster_id"]
+        assert r["ticks_run"] == 96
+        assert r["violations"] == int(rep.violations[c])
+        assert r["first_violation_tick"] == int(rep.first_violation_tick[c])
+        assert r["first_leader_tick"] == int(rep.first_leader_tick[c])
+        assert r["committed"] == int(rep.committed[c])
+        assert r["msg_count"] == int(rep.msg_count[c])
+        assert r["snap_installs"] == int(rep.snap_installs[c])
+
+
+def test_pool_refill_ids_are_monotone_and_unique():
+    rows, summary = _pooled(VIOL, 7, 16, 64, 32, 320)
+    ids = [r["cluster_id"] for r in rows]
+    assert len(ids) == len(set(ids)), "a global cluster id was reused"
+    assert summary["retired"] == len(rows)
+    assert summary["retired_violating"] == sum(
+        1 for r in rows if r["violations"]
+    )
+    # refill actually happened: ids beyond the first generation retired,
+    # and the monotone counter accounts for every lane ever started
+    assert max(ids) >= 16
+    assert summary["next_cluster_id"] == 16 + len(rows), (
+        "next_id must advance by exactly the number of retirements"
+    )
+    # a lane's age is always a whole number of chunks
+    assert all(r["ticks_run"] % 32 == 0 for r in rows)
+
+
+def test_pool_hits_replay_bit_exact_across_generations():
+    # the (seed, global_cluster_id) replay contract across >= 2 refill
+    # generations: every violating retired cluster must reproduce through
+    # replay_cluster with its reported ticks_run
+    rows, _ = _pooled(VIOL, 7, 16, 64, 32, 320)
+    viol = [r for r in rows if r["violations"]]
+    assert viol, "the dual-leader demo config must violate"
+    gens = {r["cluster_id"] // 16 for r in viol}
+    assert len(gens) >= 2 and max(gens) >= 1, (
+        f"need violating hits across >= 2 refill generations, got ids "
+        f"{[r['cluster_id'] for r in viol]}"
+    )
+    for r in viol[:8]:
+        st = replay_cluster(VIOL, 7, r["cluster_id"], r["ticks_run"])
+        assert int(st.violations) == r["violations"]
+        assert int(st.first_violation_tick) == r["first_violation_tick"]
+        assert int(st.shadow_len) == r["committed"]
+        assert int(st.msg_count) == r["msg_count"]
+
+
+def test_pool_hit_explains_like_a_fuzz_hit():
+    # the flight recorder works on a pool hit's (seed, global id) exactly
+    # as on a fuzz hit: traced replay reproduces the violation and decodes
+    # a violation event at the reported tick
+    from madraft_tpu.tpusim.trace import decode_events, replay_cluster_traced
+
+    rows, _ = _pooled(VIOL, 7, 16, 64, 32, 320)
+    r = next(r for r in rows if r["violations"])
+    final, rec = replay_cluster_traced(VIOL, 7, r["cluster_id"],
+                                       r["ticks_run"])
+    assert int(final.violations) == r["violations"]
+    events = decode_events(rec)
+    viol_events = [e for e in events if e.get("event") == "violation"]
+    assert viol_events, "no violation event decoded for a pool hit"
+    assert viol_events[0]["tick"] == r["first_violation_tick"]
+
+
+def test_pool_chunk_carry_is_donated():
+    # no double peak-HBM vs the fixed-horizon program: the chunk program
+    # consumes its state carry (donate_argnums), so the input buffer is
+    # dead after the call
+    from madraft_tpu.tpusim.engine import _chunk_program, _pool_init_program
+
+    static = STORM.static_key()
+    kn = STORM.knobs()
+    init = _pool_init_program(static, 16, None)
+    chunk = _chunk_program(static, 16)
+    states, keys, _ = init(jnp.asarray(3, jnp.uint32), kn,
+                           jnp.asarray(0, jnp.int32))
+    out = chunk(states, keys, kn, jnp.asarray(8, jnp.int32))
+    assert int(np.asarray(out.tick)[0]) == 8
+    with pytest.raises(Exception, match="[Dd]onat|[Dd]elet"):
+        np.asarray(states.tick)
+
+
+def test_pool_mesh_matches_unsharded():
+    # --mesh shards the lane batch over all attached devices; retirement,
+    # refill ids and every report field must be identical to the unsharded
+    # pool (wall-clock fields excluded)
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("clusters",))
+
+    def strip(rows):
+        return [
+            {k: v for k, v in r.items()
+             if k not in ("wall_s", "violations_per_s")}
+            for r in rows
+        ]
+
+    rows_u, rows_m = [], []
+    run_pool(VIOL, 7, 16, 64, chunk_ticks=32, budget_ticks=128,
+             on_retired=rows_u.append)
+    run_pool(VIOL, 7, 16, 64, chunk_ticks=32, budget_ticks=128,
+             mesh=mesh, on_retired=rows_m.append)
+    assert strip(rows_m) == strip(rows_u)
+
+
+def test_pool_budget_seconds_terminates():
+    # wall-clock budget: stops at the first harvest past the budget, and
+    # still reports whatever retired on the way
+    rows = []
+    summary = run_pool(VIOL, 11, 16, 64, chunk_ticks=32,
+                       budget_seconds=0.001, on_retired=rows.append)
+    assert summary["lane_ticks"] >= 32  # at least one chunk always runs
+    assert summary["retired"] == len(rows)
+
+
+def test_sweep_uniform_dispatch_matches_per_cluster():
+    # the knob-layout cliff fix for small grids: <= K contiguous knob cells
+    # dispatch as per-cell uniform-knob programs (the fast layout); the
+    # report must be bit-identical to the per-cluster-knob program, field
+    # by field — same knob values reaching the same (seed, cluster_id)
+    # streams
+    n, per = 12, 6
+    loss = jnp.repeat(jnp.asarray([0.0, 0.3], jnp.float32), per)
+    kn = STORM.knobs()._replace(loss_prob=loss)
+    fast = make_sweep_fn(STORM, kn, n, 160)
+    slow = make_sweep_fn(STORM, kn, n, 160, uniform_max_cells=0)
+    assert fast.dispatch == "uniform"
+    assert slow.dispatch == "per_cluster"
+    ra, rb = report(fast(5)), report(slow(5))
+    for f in ra._fields:
+        np.testing.assert_array_equal(getattr(ra, f), getattr(rb, f),
+                                      err_msg=f"sweep layout drift in {f}")
+    # AOT split works on the uniform dispatch too (run_telemetry path)
+    assert fast.compile_timed(5) is not None
+    rc = report(fast(5))
+    for f in rc._fields:
+        np.testing.assert_array_equal(getattr(ra, f), getattr(rc, f))
+
+
+def test_sweep_uniform_falls_back_above_cell_cap():
+    # 16 distinct cells > the K=8 cap: the heterogeneous program must be
+    # chosen (per-cell batches would under-fill the chip)
+    n = 16
+    loss = jnp.arange(n, dtype=jnp.float32) / (2 * n)
+    kn = STORM.knobs()._replace(loss_prob=loss)
+    fn = make_sweep_fn(STORM, kn, n, 8)
+    assert fn.dispatch == "per_cluster"
