@@ -67,8 +67,10 @@ def test_bug_commit_any_term_caught():
     # THE Figure-8 bug: commit by counting replicas of an old-term entry.
     # A later leader that never saw the entry overwrites it => the commit
     # shadow (committed entries are immutable) must fire.
+    # 512 clusters: measured 3 violating / 3 commit-shadow at this size —
+    # deterministic margin for the asserts at half the batch (CI wall).
     rep = fuzz(FIG8.replace(bug="commit_any_term"), seed=8,
-               n_clusters=1024, n_ticks=1000)
+               n_clusters=512, n_ticks=1000)
     assert rep.n_violating > 0, "figure-8 commit bug escaped the oracles"
     assert (_bits(rep) & VIOLATION_COMMIT_SHADOW).any()
 
@@ -85,8 +87,11 @@ def test_bug_grant_any_vote_caught():
 def test_bug_forget_voted_for_caught():
     # votedFor not persisted: a voter that crashes and restarts within one
     # term can vote twice, electing two leaders in that term.
+    # 512 clusters: deterministic for a fixed (seed, shape), and measured 4
+    # violating / 3 dual-leader at this size — enough margin for the > 0
+    # asserts without the 2048-cluster batch (168s of 2-core CI wall).
     rep = fuzz(REVOTE.replace(bug="forget_voted_for"), seed=8,
-               n_clusters=2048, n_ticks=1000)
+               n_clusters=512, n_ticks=1000)
     assert rep.n_violating > 0, "votedFor-persistence bug escaped the oracles"
     assert (_bits(rep) & VIOLATION_DUAL_LEADER).any()
 

@@ -2,7 +2,7 @@
 histories far past the window capacity, snapshot catch-up of lagging nodes,
 and the KV service surviving snapshot handoff of its dup tables.
 
-Runs on the 8-device virtual CPU mesh from conftest.py.
+Runs on the virtual CPU device mesh from conftest.py.
 """
 
 import numpy as np

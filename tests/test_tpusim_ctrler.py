@@ -8,13 +8,12 @@ balance (tester.rs:113-150), minimal transfers (tests.rs:122-163,239-278),
 historical query_at (tests.rs:64-75), and config equality across leader
 failover (tests.rs:280-296); these tests are the batched analogue.
 
-Runs on the 8-device virtual CPU mesh from conftest.py.
+Runs on the virtual CPU device mesh from conftest.py.
 """
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from madraft_tpu.tpusim import SimConfig
 from madraft_tpu.tpusim.ctrler import (
@@ -323,11 +322,10 @@ def test_ctrler_deterministic_and_replay():
 
 
 def test_ctrler_sharded_over_mesh():
-    """The cluster axis shards over the 8-device mesh, results identical."""
-    devs = np.array(jax.devices()[:8])
-    if len(devs) < 8:
-        pytest.skip("needs the 8-device virtual mesh")
-    mesh = jax.sharding.Mesh(devs, ("clusters",))
+    """The cluster axis shards over the device mesh, results identical."""
+    from conftest import cluster_mesh
+
+    mesh = cluster_mesh(64)
     fn = make_ctrler_fuzz_fn(BASE, CT, n_clusters=64, n_ticks=128, mesh=mesh)
     rep_sharded = ctrler_report(
         jax.block_until_ready(fn(jnp.asarray(5, jnp.uint32)))

@@ -1,7 +1,7 @@
 """KV-service fuzzing layer tests (Lab 3 on TPU): exactly-once, agreement,
 oracle validation via bug injection, determinism, and sharded execution.
 
-Runs on the 8-device virtual CPU mesh from conftest.py.
+Runs on the virtual CPU device mesh from conftest.py.
 """
 
 import jax
@@ -114,11 +114,10 @@ def test_kv_deterministic_and_replay():
 
 
 def test_kv_sharded_over_mesh():
-    """The cluster axis shards over an 8-device mesh with identical results."""
-    devs = np.array(jax.devices()[:8])
-    if len(devs) < 8:
-        pytest.skip("needs the 8-device virtual mesh")
-    mesh = jax.sharding.Mesh(devs, ("clusters",))
+    """The cluster axis shards over the device mesh with identical results."""
+    from conftest import cluster_mesh
+
+    mesh = cluster_mesh(64)
     fn = make_kv_fuzz_fn(BASE, KV, n_clusters=64, n_ticks=128, mesh=mesh)
     rep_sharded = kv_report(jax.block_until_ready(fn(jnp_seed(5))))
     rep_local = kv_fuzz(BASE, KV, seed=5, n_clusters=64, n_ticks=128)

@@ -1,6 +1,6 @@
 """Multi-chip sharding of the cluster batch over a device mesh.
 
-Runs on the 8-device virtual CPU mesh (conftest.py). The driver's
+Runs on the virtual CPU device mesh (conftest.py). The driver's
 dryrun_multichip does the same through __graft_entry__.
 """
 

@@ -5,7 +5,7 @@ injection, and determinism. The reference scenarios these batch:
 /root/reference/src/shardkv/tests.rs:70-362 (join/leave + concurrent +
 crash storms), 438-493 (challenge 1), 499-605 (challenge 2).
 
-Runs on the 8-device virtual CPU mesh from conftest.py.
+Runs on the virtual CPU device mesh from conftest.py.
 """
 
 import functools
@@ -32,16 +32,37 @@ from madraft_tpu.tpusim.shardkv import (
 # wandering between put_executable_and_time and backend_compile_and_load;
 # standalone module runs always pass). Two mitigations, both module-scoped:
 # skip persistent-cache WRITES (serialize is one crash site), and CLEAR the
-# in-process executable caches once before the module (the accumulation is
-# the trigger; earlier modules' executables are dead weight by now anyway).
+# in-process executable caches once before the module. Since the round-6
+# conftest reorder this module runs FIRST in full-suite runs (young process,
+# outside the accumulation zone — the clear is then a no-op), but the
+# defenses stay: standalone invocations like `pytest tests/` subsets or
+# MADRAFT_TPU_TESTS=1 runs don't go through the reorder guarantee alone.
 @pytest.fixture(autouse=True, scope="module")
 def _fresh_xla_state_for_big_programs():
+    import contextlib
+    import os as _os
+
     import jax as _jax
 
     _jax.clear_caches()
     from conftest import no_persistent_cache
 
-    with no_persistent_cache():
+    # MADTPU_SHARDKV_CACHE_WRITE=1: allow persistent-cache writes anyway.
+    # Safe whenever this module compiles on a YOUNG process (the crash
+    # trigger needs 100+ prior programs): standalone runs
+    #   MADTPU_SHARDKV_CACHE_WRITE=1 pytest tests/test_tpusim_shardkv.py
+    # trivially qualify, and full-suite runs qualify via the conftest
+    # reorder that puts this module first — ci.sh and the GitHub workflow
+    # both set the var so .jax_cache gains these executables and later runs
+    # DESERIALIZE them (cache reads are unaffected by this fixture),
+    # skipping both crash sites (serialize and backend_compile) and the
+    # several minutes of per-run shardkv compile. Default (var unset) still
+    # suppresses writes: subset runs like `pytest tests/test_*.py k...`
+    # don't get the reorder's young-process guarantee.
+    guard = (contextlib.nullcontext()
+             if _os.environ.get("MADTPU_SHARDKV_CACHE_WRITE") == "1"
+             else no_persistent_cache())
+    with guard:
         yield
 
 
@@ -90,13 +111,15 @@ def test_shardkv_schedule_is_join_leave():
 def test_shardkv_migration_clean():
     """Reconfiguration churn with no faults: zero violations, ops flow, every
     migration completes and every surrendered copy is GC'd (challenge 1)."""
-    rep = shardkv_fuzz(RAFT, SKV, seed=5, n_clusters=24, n_ticks=TICKS)
+    # 16 deployments: deterministic per (seed, shape); measured 178 installs
+    # and min 28 acked ops at this size — margin intact at 2/3 the wall
+    rep = shardkv_fuzz(RAFT, SKV, seed=5, n_clusters=16, n_ticks=TICKS)
     assert rep.n_violating == 0, (
         f"violations {rep.violations[rep.violating_clusters()[:8]]}"
     )
     assert (rep.acked_ops > 20).all()
     assert (rep.acked_gets > 0).all(), "the read path must see traffic"
-    assert rep.installs.sum() > 100, "multi-shard churn must migrate a lot"
+    assert rep.installs.sum() > 66, "multi-shard churn must migrate a lot"
     # challenge 1 at quiesce: every frozen copy was deleted, one owner/shard
     assert (rep.deletes == rep.installs).mean() > 0.85
     assert (rep.frozen_left == 0).mean() > 0.85
@@ -148,7 +171,7 @@ def test_shardkv_live_ctrler_clean():
         p_repartition=0.03, p_heal=0.08,
     )
     kcfg = SKV.replace(live_ctrler=True, p_phantom=0.4, cfg_interval=40)
-    rep = shardkv_fuzz(storm, kcfg, seed=3, n_clusters=24, n_ticks=TICKS)
+    rep = shardkv_fuzz(storm, kcfg, seed=3, n_clusters=16, n_ticks=TICKS)
     assert rep.n_violating == 0, (
         f"violations {rep.violations[rep.violating_clusters()[:8]]} raft "
         f"{rep.raft_violations[rep.violating_clusters()[:8]]}"
@@ -156,7 +179,7 @@ def test_shardkv_live_ctrler_clean():
     assert (rep.ann_resolved >= 2).mean() > 0.8, (
         f"the live controller barely committed announces: {rep.ann_resolved}"
     )
-    assert rep.installs.sum() > 24, "migrations must flow from live configs"
+    assert rep.installs.sum() > 16, "migrations must flow from live configs"
     assert (rep.final_cfg >= 1).mean() > 0.8, (
         f"groups barely adopted live configs: {rep.final_cfg}"
     )
@@ -178,7 +201,7 @@ def test_shardkv_live_ctrler_stale_read_bug_caught():
         live_ctrler=True, bug_stale_ctrler_read=True, p_phantom=0.5,
         cfg_interval=40,
     )
-    rep = shardkv_fuzz(storm, kcfg, seed=5, n_clusters=32, n_ticks=512)
+    rep = shardkv_fuzz(storm, kcfg, seed=5, n_clusters=16, n_ticks=512)
     stale = (rep.violations & VIOLATION_SHARD_CTRL_STALE) != 0
     assert stale.any(), (
         "no deployment adopted a never-committed config — the planted "
@@ -281,14 +304,13 @@ def test_shardkv_deterministic():
 
 
 def test_shardkv_sharded_over_mesh():
-    """The deployment axis shards over the 8-device mesh with identical
+    """The deployment axis shards over the virtual device mesh with identical
     results (the dryrun_multichip path for the groups axis)."""
-    devs = np.array(jax.devices()[:8])
-    if len(devs) < 8:
-        pytest.skip("needs the 8-device virtual mesh")
+    from conftest import cluster_mesh
+
+    mesh = cluster_mesh(16)
     import jax.numpy as jnp
 
-    mesh = jax.sharding.Mesh(devs, ("clusters",))
     fn = make_shardkv_fuzz_fn(RAFT, SKV, n_clusters=16, n_ticks=128, mesh=mesh)
     rep_sharded = shardkv_report(
         jax.block_until_ready(fn(jnp.asarray(4, jnp.uint32)))
