@@ -294,6 +294,101 @@ def bench_pool(n_lanes: int, budget_ticks: int) -> dict:
     }
 
 
+def bench_coverage(n_lanes: int, budget_ticks: int) -> dict:
+    """Coverage-guided vs uniform-random A/B (ROADMAP item 3), two legs:
+
+    GROUND TRUTH — the 3-node small-alphabet config whose abstract-state
+    space coverage.enumerate_abstract_codes enumerates offline (the
+    LNT/mCRL2-style yardstick): fraction of enumerated states reached per
+    chip-second, guided vs random, SAME lanes and SAME tick budget. The
+    bitmap maps states 1:1 (identity mode), so the fractions are exact
+    counts, not hash estimates.
+
+    BUG HUNT — the planted-bug durability profile: new-fingerprints and
+    violations per chip-second, guided vs random. Both legs run the SAME
+    coverage programs (random = measurement-only refill), so the per-step
+    cost is identical and the per-chip-second comparison is pure policy.
+    """
+    from madraft_tpu.tpusim.config import (
+        CoverageConfig,
+        coverage_ground_truth,
+        storm_profiles,
+    )
+    from madraft_tpu.tpusim.coverage import enumerate_abstract_codes
+
+    gt_cfg, gt_ccfg, gt_horizon = coverage_ground_truth()
+    total = int(len(enumerate_abstract_codes(gt_cfg.n_nodes, gt_ccfg)))
+    gt_budget = max(budget_ticks, 40 * gt_horizon)
+
+    def leg(cfg, ccfg, horizon, budget, seed=12345):
+        # run_pool warms its programs outside its timed window
+        s = run_pool(cfg, seed, n_lanes, horizon, budget_ticks=budget,
+                     coverage=ccfg)
+        return s
+
+    g = leg(gt_cfg, gt_ccfg, gt_horizon, gt_budget)
+    r = leg(gt_cfg, gt_ccfg.replace(guided=False), gt_horizon, gt_budget)
+
+    prof, _, rec_ticks, _bugs = storm_profiles()["durability"]
+    bug_cfg = prof.replace(bug="ack_before_fsync")
+    horizon = min(rec_ticks, budget_ticks)
+    dcc = CoverageConfig()
+    bg = leg(bug_cfg, dcc, horizon, budget_ticks, seed=1)
+    br = leg(bug_cfg, dcc.replace(guided=False), horizon, budget_ticks,
+             seed=1)
+
+    def frac(s):
+        return s["coverage"]["seen_fingerprints"] / total
+
+    def frac_per_s(s):
+        return frac(s) / s["wall_s"] if s["wall_s"] > 0 else None
+
+    return {
+        "ground_truth": {
+            "config": "3-node/64-tick/2-level alphabet",
+            "enumerated_states": total,
+            "lanes": n_lanes,
+            "budget_ticks": gt_budget,
+            "guided_states": g["coverage"]["seen_fingerprints"],
+            "random_states": r["coverage"]["seen_fingerprints"],
+            "guided_frac": round(frac(g), 4),
+            "random_frac": round(frac(r), 4),
+            "guided_wall_s": g["wall_s"],
+            "random_wall_s": r["wall_s"],
+            "guided_frac_per_chip_s": round(frac_per_s(g) or 0.0, 5),
+            "random_frac_per_chip_s": round(frac_per_s(r) or 0.0, 5),
+            "state_ratio": (
+                round(frac(g) / frac(r), 3) if frac(r) else None
+            ),
+        },
+        "durability_bug": {
+            "profile": "durability",
+            "bug": "ack_before_fsync",
+            "lanes": n_lanes,
+            "budget_ticks": budget_ticks,
+            "horizon": horizon,
+            # hashed-bitmap mode (5-node alphabet >> bitmap): the new_fps
+            # counts below are collision-distorted popcounts, not exact
+            # state counts like the identity-mapped ground-truth leg's
+            "identity": bg["coverage"]["identity"],
+            "guided_new_fps": bg["coverage"]["seen_fingerprints"],
+            "random_new_fps": br["coverage"]["seen_fingerprints"],
+            "guided_violations": bg["retired_violating"],
+            "random_violations": br["retired_violating"],
+            "guided_wall_s": bg["wall_s"],
+            "random_wall_s": br["wall_s"],
+            "guided_viol_per_chip_s": bg["violations_per_s"],
+            "random_viol_per_chip_s": br["violations_per_s"],
+            "guided_new_fps_per_chip_s": (
+                bg["coverage"]["new_fingerprints_per_s"]
+            ),
+            "random_new_fps_per_chip_s": (
+                br["coverage"]["new_fingerprints_per_s"]
+            ),
+        },
+    }
+
+
 def main() -> None:
     # MADTPU_BENCH_PLATFORM=cpu forces the CPU backend (ci.sh fallback when
     # no healthy accelerator is attached); must run before backend init.
@@ -337,6 +432,10 @@ def main() -> None:
     # horizons makes it first-order (PERF.md round 6); smokes keep a small
     # budget so the row stays cheap on CPU
     pool = bench_pool(max(64, n_clusters // 16), max(2400, 12 * n_ticks))
+    # coverage-guided vs uniform-random A/B (ROADMAP item 3): the
+    # ground-truth reached-fraction comparison plus the planted-bug leg;
+    # a smaller budget than the pool row — two extra pool runs per leg
+    covr = bench_coverage(max(64, n_clusters // 16), max(1200, 6 * n_ticks))
     steps_per_sec = raft.pop("steps_per_sec")
     print(
         json.dumps(
@@ -375,6 +474,10 @@ def main() -> None:
                         "viol_per_chip_s_ratio"
                     ],
                     "pool": pool,
+                    "coverage_state_ratio": covr["ground_truth"][
+                        "state_ratio"
+                    ],
+                    "coverage": covr,
                     "device": str(jax.devices()[0]),
                     **({"degraded": degraded} if degraded else {}),
                 },

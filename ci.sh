@@ -128,6 +128,36 @@ print(f"pool smoke: bug leg retired {len(rows)} violating "
       f"(first={rows[0]['cluster_id']}), clean leg 64/64 at horizon")
 PY
 
+# coverage smoke (ISSUE 6): the coverage-GUIDED pool on the planted-bug
+# profile must still retire >= 1 violating cluster (generation 1 is
+# bit-identical to the plain pool; only refill policy differs after), must
+# report a nonzero new-fingerprint count, and its JSONL rows must carry the
+# coverage columns (new_fingerprints / refill / knobs) that make mutated
+# lanes replayable. Coverage programs are separate cached programs, so this
+# leg's compiles never touch the plain pool's warm cache entries.
+MADTPU_PLATFORM=cpu python - <<'PY'
+import contextlib, io, json
+from madraft_tpu.__main__ import main
+
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["pool", "--profile", "durability", "--bug", "ack_before_fsync",
+               "--clusters", "64", "--ticks", "300", "--chunk-ticks", "100",
+               "--budget-ticks", "600", "--seed", "1", "--coverage"])
+lines = [json.loads(x) for x in buf.getvalue().strip().splitlines()]
+summary, rows = lines[-1], lines[:-1]
+assert rc == 1, f"coverage bug leg exit {rc} != 1"
+assert summary["retired_violating"] >= 1, summary
+cov = summary["coverage"]
+assert cov["guided"] and cov["seen_fingerprints"] > 0, cov
+assert any(r["new_fingerprints"] > 0 for r in rows), "no lane discovered"
+assert all("refill" in r and "knobs" in r for r in rows)
+print(f"coverage smoke: {summary['retired_violating']} violating, "
+      f"{cov['seen_fingerprints']} fingerprints over "
+      f"{cov['generations']} generations "
+      f"(mutated {cov['refills_mutated']}, fresh {cov['refills_fresh']})")
+PY
+
 echo "== [5/5] bench smoke (1024 clusters x 128 ticks)"
 # prefer the attached accelerator; fall back to CPU if it is absent or hung
 timeout 600 python bench.py 1024 128 \

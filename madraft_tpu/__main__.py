@@ -101,6 +101,31 @@ def _sim_config(args):
     return cfg
 
 
+def _knobs_json(verb: str, raw: str):
+    """``--knobs-json`` value -> dict (or None when absent), with clean CLI
+    errors at exit code 2 (the argparse usage-error convention) so a bad
+    row stays distinguishable from replay's violation-found exit 1."""
+    if not raw:
+        return None
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError as e:
+        print(f"{verb}: --knobs-json is not valid JSON: {e}",
+              file=sys.stderr)
+        raise SystemExit(2)
+
+
+def _replay_or_usage_error(verb: str, fn, *a, **kw):
+    """Run a replay-family call, converting the eager knob-validation
+    ValueErrors (unknown field, out-of-range, non-object row) into clean
+    usage errors instead of raw tracebacks."""
+    try:
+        return fn(*a, **kw)
+    except ValueError as e:
+        print(f"{verb}: {e}", file=sys.stderr)
+        raise SystemExit(2)
+
+
 def _mesh(args):
     """--mesh: shard the cluster batch over every attached device (the
     workload's scaling axis — pure data parallelism, no cross-chip
@@ -224,6 +249,39 @@ def cmd_pool(args):
     budget_ticks = args.budget_ticks if args.budget_ticks > 0 else None
     budget_seconds = args.budget_seconds if args.budget_seconds > 0 else None
     emit_all = args.emit == "all"
+    def usage_error(msg):
+        # exit 2 (argparse convention), NOT 1: for pool, exit 1 is the
+        # documented "violation found" signal automation keys on
+        print(f"pool: {msg}", file=sys.stderr)
+        raise SystemExit(2)
+
+    ccfg = None
+    if not args.coverage and (args.coverage_random
+                              or args.coverage_bits is not None):
+        # a silently-ignored modifier would run the WRONG program: a user
+        # asking for the A/B baseline must not get the plain pool (no
+        # coverage dict, different compiled program) without noticing.
+        # --coverage-bits defaults to None (not the real default) so an
+        # EXPLICIT default-valued pass still trips this gate.
+        usage_error(
+            "--coverage-random/--coverage-bits modify --coverage — add "
+            "--coverage (or drop them)"
+        )
+    if args.coverage:
+        from madraft_tpu.tpusim.config import CoverageConfig
+
+        if args.mesh:
+            usage_error(
+                "--coverage is single-device for now (the seen-set bitmap "
+                "is one shared array; ROADMAP item 1 owns the sharded "
+                "pool) — drop --mesh or --coverage"
+            )
+        bits = {} if args.coverage_bits is None else \
+            {"bitmap_bits": args.coverage_bits}
+        try:
+            ccfg = CoverageConfig(guided=not args.coverage_random, **bits)
+        except ValueError as e:  # e.g. --coverage-bits 100
+            usage_error(str(e))
 
     def on_retired(row):
         if emit_all or row["violations"]:
@@ -233,7 +291,7 @@ def cmd_pool(args):
         cfg, args.seed, args.clusters, args.ticks,
         chunk_ticks=args.chunk_ticks, budget_ticks=budget_ticks,
         budget_seconds=budget_seconds, mesh=_mesh(args),
-        on_retired=on_retired,
+        on_retired=on_retired, coverage=ccfg,
     )
     dev = jax.devices()[0]
     summary.update(
@@ -446,7 +504,10 @@ def cmd_replay(args):
     from madraft_tpu.tpusim.config import violation_names
     from madraft_tpu.tpusim.engine import replay_cluster
 
-    st = replay_cluster(_sim_config(args), args.seed, args.cluster, args.ticks)
+    knobs = _knobs_json("replay", args.knobs_json)
+    st = _replay_or_usage_error(
+        "replay", replay_cluster, _sim_config(args), args.seed, args.cluster,
+        args.ticks, knobs=knobs)
     print(json.dumps({
         "seed": args.seed,
         "cluster": args.cluster,
@@ -473,8 +534,10 @@ def cmd_explain(args):
     )
 
     cfg = _sim_config(args)
-    final, rec = replay_cluster_traced(cfg, args.seed, args.cluster,
-                                       args.ticks)
+    knobs = _knobs_json("explain", args.knobs_json)
+    final, rec = _replay_or_usage_error(
+        "explain", replay_cluster_traced, cfg, args.seed, args.cluster,
+        args.ticks, knobs=knobs)
     events = decode_events(rec)
     viol = int(final.violations)
     fvt = int(final.first_violation_tick)
@@ -638,6 +701,24 @@ def main(argv=None) -> int:
     sp.add_argument("--emit", default="all", choices=["all", "violations"],
                     help="stream every retired-cluster report, or only "
                          "violating ones")
+    sp.add_argument("--coverage", action="store_true",
+                    help="coverage-guided corpus scheduling (README "
+                         "'Coverage-guided fuzzing'): every tick each "
+                         "lane's abstract-state fingerprint updates an "
+                         "on-device seen-set, and retiring lanes that "
+                         "discovered new fingerprints respawn with mutated "
+                         "storm knobs (the JSONL rows gain "
+                         "new_fingerprints/refill/knobs columns; the "
+                         "summary a coverage dict)")
+    sp.add_argument("--coverage-bits", type=int, default=None,
+                    help="seen-set bitmap size in bits (power of two, "
+                         "default 65536); small enough to saturate = "
+                         "coverage plateaus read as saturation, not "
+                         "exhaustion")
+    sp.add_argument("--coverage-random", action="store_true",
+                    help="with --coverage: count coverage but refill "
+                         "uniformly at the base knobs (measurement-only "
+                         "mode — the random baseline of the A/B)")
     sp.set_defaults(fn=cmd_pool)
 
     sp = sub.add_parser("kv-fuzz", help="KV service fuzz (Lab 3)")
@@ -692,6 +773,10 @@ def main(argv=None) -> int:
     sp = sub.add_parser("replay", help="re-run ONE cluster exactly")
     common(sp, 1)
     sp.add_argument("--cluster", type=int, required=True)
+    sp.add_argument("--knobs-json", default="",
+                    help="JSON object of dynamic-knob overrides (field -> "
+                         "value) — paste a coverage-pool row's \"knobs\" "
+                         "to replay a mutated lane bit-exactly")
     sp.set_defaults(fn=cmd_replay)
 
     sp = sub.add_parser(
@@ -714,6 +799,10 @@ def main(argv=None) -> int:
                     help="with --format chrome: write the trace JSON to "
                          "this file (a summary line goes to stdout) "
                          "instead of dumping it to stdout")
+    sp.add_argument("--knobs-json", default="",
+                    help="JSON object of dynamic-knob overrides — paste a "
+                         "coverage-pool row's \"knobs\" so the timeline "
+                         "decodes the mutated lane's actual execution")
     sp.set_defaults(fn=cmd_explain)
 
     sp = sub.add_parser(

@@ -11,7 +11,7 @@ independent (seed x fault-schedule) clusters per step; safety invariants
 (election safety, log matching, commit durability) run as on-device reductions.
 """
 
-from madraft_tpu.tpusim.config import SimConfig
+from madraft_tpu.tpusim.config import CoverageConfig, SimConfig
 from madraft_tpu.tpusim.state import ClusterState, init_cluster
 from madraft_tpu.tpusim.step import step_cluster
 from madraft_tpu.tpusim.engine import FuzzReport, fuzz, make_fuzz_fn
@@ -60,6 +60,7 @@ from madraft_tpu.tpusim.shardkv import (
 
 __all__ = [
     "SimConfig",
+    "CoverageConfig",
     "CtrlerConfig",
     "CtrlerFuzzReport",
     "CtrlerState",

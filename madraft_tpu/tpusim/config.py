@@ -353,6 +353,116 @@ def storm_profiles() -> dict:
         "durability": (durability, 256, 600, ("ack_before_fsync",)),
     }
 
+# ---------------------------------------------------------------------------
+# Coverage-guided schedule search (ROADMAP item 3; subsystem lives in
+# coverage.py, corpus scheduler in engine.run_pool). The knobs are STATIC on
+# purpose: bitmap size and quantization levels shape the compiled coverage
+# programs (array sizes / fold constants), exactly like SimConfig's shape
+# knobs — and the coverage programs are SEPARATE cached programs, so enabling
+# coverage never touches the plain fuzz/pool HLO (golden-guard property).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CoverageConfig:
+    """Static knobs of the on-device coverage subsystem (coverage.py).
+
+    The abstract state of one cluster at one tick is the per-node tuple
+    (role, alive, term-rank, commit-delta) quantized to a tiny alphabet
+    (state.abstract_node_tuple); its u32 code indexes a device-resident
+    power-of-two seen-set bitmap. When the whole code space fits the bitmap
+    (the ground-truth configs) the mapping is the identity — one bit is one
+    abstract state, which is what the offline enumerator A/B measures.
+    """
+
+    bitmap_bits: int = 1 << 16   # seen-set size (power of two, bool bitmap)
+    term_rank_levels: int = 3    # term-rank quantization (#nodes strictly
+    #                              behind, clipped) — who is ahead, not by
+    #                              how much
+    commit_delta_levels: int = 3  # commit - min(commit), clipped — who lags
+    #                               the commit frontier
+    guided: bool = True          # biased refill on; False = measurement-only
+    #                              (count coverage, refill exactly like the
+    #                              plain pool — the random A/B baseline)
+    # Refill-mutation shape (coverage.refill_knobs): a productive retiring
+    # lane's float storm knobs are jittered multiplicatively within
+    # [1/mut_span, mut_span]; an unproductive lane redraws each knob
+    # uniformly in [fresh_lo, fresh_hi] x its base value (clipped to [0,1]).
+    # A knob the base profile disabled (0.0) stays 0 under both rules.
+    mut_span: float = 2.0
+    fresh_lo: float = 0.25
+    fresh_hi: float = 2.5
+
+    def __post_init__(self):
+        if self.bitmap_bits <= 0 or self.bitmap_bits & (self.bitmap_bits - 1):
+            raise ValueError(
+                f"bitmap_bits must be a power of two, got {self.bitmap_bits}"
+            )
+        if self.term_rank_levels < 2 or self.commit_delta_levels < 2:
+            raise ValueError(
+                "term_rank_levels and commit_delta_levels must be >= 2 "
+                f"(got {self.term_rank_levels}, {self.commit_delta_levels})"
+            )
+        if self.mut_span <= 1.0:
+            raise ValueError(f"mut_span must be > 1, got {self.mut_span}")
+        if not 0.0 <= self.fresh_lo <= self.fresh_hi:
+            raise ValueError(
+                f"fresh span empty: [{self.fresh_lo}, {self.fresh_hi}]"
+            )
+
+    def replace(self, **kw) -> "CoverageConfig":
+        return dataclasses.replace(self, **kw)
+
+    def fingerprint_key(self) -> "CoverageConfig":
+        """Canonical config carrying only the fields the FINGERPRINT path
+        reads (bitmap size + quantization levels) — the SimConfig.static_key
+        idiom. The coverage chunk program is cached on this, so flipping the
+        refill policy (guided/mut_span/fresh_*, harvest-only knobs) between
+        the A/B legs shares one compiled chunk executable instead of
+        re-tracing a bit-identical program."""
+        return CoverageConfig(
+            bitmap_bits=self.bitmap_bits,
+            term_rank_levels=self.term_rank_levels,
+            commit_delta_levels=self.commit_delta_levels,
+        )
+
+
+def coverage_ground_truth() -> tuple:
+    """The 3-node / short-horizon / small-alphabet validation config
+    (ROADMAP item 3, in the style of the LNT/mCRL2 exhaustive Raft models,
+    arXiv:2004.13284 / 2403.18916): the abstract-state space is small enough
+    for coverage.enumerate_abstract_codes to enumerate offline, and the
+    bitmap is sized so the code->bit mapping is the IDENTITY — measured
+    coverage is an exact reached-state count, not a hash estimate.
+
+    Returns (SimConfig, CoverageConfig, horizon_ticks) — shared by
+    tests/test_coverage.py and bench.py's random-vs-guided A/B row.
+
+    The base fault knobs are deliberately MILD (untuned defaults, not a
+    hand-tuned storm): that is the regime guided search exists for — the
+    uniform-random pool keeps refilling at the base point and saturates its
+    neighborhood, while the guided pool's wide fresh prior (fresh_hi x base)
+    plus mutation around productive lanes climbs to the fault intensities
+    that actually diversify the abstract states. Measured at this profile:
+    guided reaches 1.18-1.34x the states of random at equal tick budget
+    across seeds (PERF.md round 7). Against a hand-tuned storm base the
+    same machinery measured ~0.9x — guidance cannot beat an oracle that
+    already sits on the sweet spot, and the A/B is honest about which
+    question it answers.
+    """
+    cfg = SimConfig(
+        n_nodes=3, log_cap=16, ae_max=2, compact_every=4,
+        p_client_cmd=0.2, loss_prob=0.02, p_crash=0.01, p_restart=0.3,
+        max_dead=1, p_repartition=0.01, p_heal=0.05,
+    )
+    # per-node alphabet 3*2*2*2 = 24; 24^3 = 13824 codes <= 2^14 bits
+    ccfg = CoverageConfig(
+        bitmap_bits=1 << 14, term_rank_levels=2, commit_delta_levels=2,
+        fresh_lo=0.0, fresh_hi=8.0,
+    )
+    return cfg, ccfg, 64
+
+
 # Log value of the no-op entry a freshly elected leader appends (step.py win
 # block): guarantees the new term has a committable entry even while flow
 # control gates service proposals. Far above any packed service op or

@@ -1,0 +1,223 @@
+"""Coverage-guided schedule search: on-device abstract-state fingerprints.
+
+ROADMAP item 3. Storm schedules were uniform-random, so rare interleavings
+(the fig-8 class) were found only by raw volume. This module defines the
+*abstract state* of a cluster — the per-node (role, alive, term-rank,
+commit-delta) tuple from ``state.abstract_node_tuple``, folded into one u32
+code per lane per tick — plus the pieces the engine's coverage pool
+(``engine.run_pool(coverage=...)``) composes:
+
+- ``abstract_code``     the per-tick fingerprint (pure function of
+                        ``ClusterState``), computed inside the coverage
+                        chunk program for every lane at every tick
+- ``bitmap_index``      code -> seen-set bit. When the whole code space fits
+                        the bitmap the mapping is the IDENTITY (one bit ==
+                        one abstract state — the exact-count mode the
+                        ground-truth A/B needs); otherwise a murmur3-style
+                        avalanche mixes the code before masking
+- ``refill_knobs``      the biased refill policy: a retiring lane that
+                        discovered new fingerprints gets its float storm
+                        knobs jittered (its schedule neighborhood is worth
+                        exploring); an unproductive lane redraws fresh
+                        knobs from the prior. Draws are a pure function of
+                        (seed, new global id), so a lane's knob row — which
+                        every coverage JSONL report carries — replays
+                        bit-exactly through ``replay_cluster(...,
+                        knobs=row)``
+- ``enumerate_abstract_codes``  the offline ground-truth harness: for a tiny
+                        config (``config.coverage_ground_truth``) it
+                        enumerates every structurally-valid abstract code,
+                        the denominator of the reached-state fraction that
+                        validates guided-beats-random per chip-second (the
+                        exhaustive-model-checking yardstick of the LNT/mCRL2
+                        Raft models, arXiv:2004.13284 / 2403.18916)
+
+The coverage programs are SEPARATE cached programs (engine.py): with
+coverage off, no existing fuzz/pool program's HLO changes — the golden
+guard (tests/golden_fuzz.json) pins this.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madraft_tpu.tpusim.config import CoverageConfig, Knobs
+from madraft_tpu.tpusim.state import ClusterState, abstract_node_tuple
+
+U32 = jnp.uint32
+
+# The storm knobs the biased refill may mutate: every per-tick Bernoulli
+# probability. All live in [0, 1] (clipped after mutation), so a mutated row
+# always passes engine._validate_knobs; the int knobs (timeout spans, delay
+# spans, cadences, quorum) keep their base values — mutating those would
+# change the compiled program's semantics class, not just the schedule
+# density, and several carry cross-field validity constraints.
+MUTABLE_KNOBS = (
+    "loss_prob", "p_crash", "p_restart", "p_repartition", "p_heal",
+    "p_leader_part", "p_asym_cut", "p_client_cmd", "p_lose_unsynced",
+)
+
+# PRNG domain separation for the refill-mutation draws: the stream must be
+# disjoint from the cluster streams (fold_in(PRNGKey(seed), global_id)), so
+# the mutation key hangs off seed ^ _COV_SALT instead.
+_COV_SALT = 0x434F5647  # "COVG"
+
+# How the knobs a lane is running were produced — the ``refill`` column of
+# the coverage JSONL (engine reports the RETIRING lane's own kind).
+REFILL_SEED, REFILL_FRESH, REFILL_MUTATE = 0, 1, 2
+REFILL_NAMES = {REFILL_SEED: "seed", REFILL_FRESH: "fresh",
+                REFILL_MUTATE: "mutate"}
+
+
+def node_alphabet(ccfg: CoverageConfig) -> int:
+    """Distinct per-node abstract values: role(3) x alive(2) x rank x delta."""
+    return 3 * 2 * ccfg.term_rank_levels * ccfg.commit_delta_levels
+
+
+def code_space(n_nodes: int, ccfg: CoverageConfig) -> int:
+    """Size of the full abstract-code space (before reachability filters)."""
+    return node_alphabet(ccfg) ** n_nodes
+
+
+def identity_mapped(n_nodes: int, ccfg: CoverageConfig) -> bool:
+    """True when every abstract code owns its own seen-set bit (no hashing):
+    the exact-count mode the ground-truth fraction measurement requires."""
+    return code_space(n_nodes, ccfg) <= ccfg.bitmap_bits
+
+
+def abstract_code(ccfg: CoverageConfig, s: ClusterState) -> jax.Array:
+    """u32 abstract-state code of ONE cluster at its current tick (vmap adds
+    the lane axis). Big-endian fold of the per-node values by node id —
+    injective whenever the code space fits u32, and u32-wraparound (harmless:
+    the non-identity path mixes anyway) beyond that."""
+    role, alive, rank, delta = abstract_node_tuple(
+        s, ccfg.term_rank_levels, ccfg.commit_delta_levels
+    )
+    node_code = (
+        ((role * 2 + alive) * ccfg.term_rank_levels + rank)
+        * ccfg.commit_delta_levels + delta
+    ).astype(U32)
+    n = node_code.shape[0]  # static
+    a = node_alphabet(ccfg)
+    weights = jnp.asarray(
+        [pow(a, n - 1 - i, 1 << 32) for i in range(n)], U32
+    )
+    return jnp.sum(node_code * weights, dtype=U32)
+
+
+def _mix32(x: jax.Array) -> jax.Array:
+    """murmur3 finalizer: full-avalanche u32 -> u32."""
+    x = (x ^ (x >> 16)) * U32(0x85EBCA6B)
+    x = (x ^ (x >> 13)) * U32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+def bitmap_index(ccfg: CoverageConfig, n_nodes: int,
+                 code: jax.Array) -> jax.Array:
+    """Seen-set bit of an abstract code: the code itself in identity mode,
+    else its avalanche hash masked to the (power-of-two) bitmap."""
+    if identity_mapped(n_nodes, ccfg):
+        return code.astype(jnp.int32)
+    return (_mix32(code) & U32(ccfg.bitmap_bits - 1)).astype(jnp.int32)
+
+
+def refill_knobs(
+    ccfg: CoverageConfig,
+    kn_lanes: Knobs,      # per-lane knob rows (leading [n] axis on every leaf)
+    base_kn: Knobs,       # the base profile's scalar knobs (the prior center)
+    retired: jax.Array,   # bool [n]
+    productive: jax.Array,  # bool [n]: retiring lane discovered new fps
+    new_ids: jax.Array,   # i32 [n]: global id after refill (fresh on retired)
+    seed: jax.Array,      # u32 scalar (the pool's seed)
+) -> tuple:
+    """Per-lane knob rows and refill kinds after a harvest.
+
+    Kept lanes keep their rows. A retired PRODUCTIVE lane's child jitters
+    each mutable knob multiplicatively within [1/mut_span, mut_span] of the
+    parent (explore the discovering schedule's neighborhood); an
+    UNPRODUCTIVE lane's child redraws each knob uniformly in
+    [fresh_lo, fresh_hi] x base (a fresh point of the prior). Everything is
+    clipped to [0, 1], and a knob the base profile disabled (base == 0)
+    stays 0 under both rules — coverage search never turns on a fault axis
+    the profile turned off.
+
+    Determinism/replay: all draws come from fold_in(PRNGKey(seed ^
+    _COV_SALT), new_global_id) — disjoint from the cluster streams and a
+    pure function of the pool's arguments, so the run is exactly
+    reproducible and the resulting row (carried in the JSONL report)
+    replays through ``engine.replay_cluster(..., knobs=row)`` bit-exactly.
+    """
+    n_mut = len(MUTABLE_KNOBS)
+    base = jax.random.PRNGKey(seed ^ _COV_SALT)
+    u = jax.vmap(
+        lambda i: jax.random.uniform(jax.random.fold_in(base, i), (n_mut,))
+    )(new_ids)  # [n, n_mut] in [0, 1)
+    span = float(np.log2(ccfg.mut_span))
+    updates = {}
+    for j, name in enumerate(MUTABLE_KNOBS):
+        parent = getattr(kn_lanes, name)
+        b = getattr(base_kn, name)
+        fresh = b * (ccfg.fresh_lo + u[:, j] * (ccfg.fresh_hi - ccfg.fresh_lo))
+        mut = parent * jnp.exp2((u[:, j] * 2.0 - 1.0) * span)
+        child = jnp.clip(jnp.where(productive, mut, fresh), 0.0, 1.0)
+        updates[name] = jnp.where(retired, child, parent).astype(parent.dtype)
+    kinds = jnp.where(productive, REFILL_MUTATE, REFILL_FRESH)
+    return kn_lanes._replace(**updates), kinds
+
+
+def enumerate_abstract_codes(n_nodes: int, ccfg: CoverageConfig) -> np.ndarray:
+    """Offline ground truth: every structurally-valid abstract code, sorted.
+
+    Filters (all provable invariants of the abstraction, see
+    state.abstract_node_tuple):
+      - some node has term-rank 0 (the minimum-term node is behind no one);
+      - every *interior* (un-clipped) rank r must count exactly r nodes
+        strictly below it — rank vectors like (0, 2, 2) with nothing at 1
+        cannot arise from any term assignment;
+      - some node has commit-delta 0 (delta is relative to min(commit)).
+
+    For 2-level quantization (the ``config.coverage_ground_truth`` alphabet)
+    the rank filter is EXACT — the enumerated set is precisely the codes any
+    term assignment can produce. At deeper quantizations, and in general
+    (the abstraction drops log/commit coupling), the result is a superset of
+    the truly reachable set, which makes it a sound denominator for the
+    reached-fraction metric: fractions are comparable between runs and
+    conservative in absolute terms.
+
+    Intended for tiny configs only (the ground-truth validation); guarded
+    against accidental use on the 5-node default alphabet, whose space
+    (54^5) is enumerable by machine but meaningless to iterate in a test.
+    """
+    space = code_space(n_nodes, ccfg)
+    if space > 1 << 20:
+        raise ValueError(
+            f"abstract code space {space} too large to enumerate — this is "
+            "the offline ground-truth harness for tiny configs "
+            "(config.coverage_ground_truth), not a general counter"
+        )
+    levels_r, levels_c = ccfg.term_rank_levels, ccfg.commit_delta_levels
+    per_node = list(itertools.product(
+        range(3), range(2), range(levels_r), range(levels_c)
+    ))
+    codes = []
+    for combo in itertools.product(per_node, repeat=n_nodes):
+        ranks = [c[2] for c in combo]
+        deltas = [c[3] for c in combo]
+        if min(ranks) != 0 or min(deltas) != 0:
+            continue
+        if any(
+            sum(r2 < r for r2 in ranks) != r
+            for r in ranks if 0 < r < levels_r - 1
+        ):
+            continue
+        code = 0
+        for role, alive, rank, delta in combo:
+            code = code * node_alphabet(ccfg) + (
+                ((role * 2 + alive) * levels_r + rank) * levels_c + delta
+            )
+        codes.append(code)
+    return np.asarray(sorted(codes), np.uint32)

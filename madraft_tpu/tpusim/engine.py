@@ -17,7 +17,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from madraft_tpu.tpusim.config import SimConfig, violation_names
+from madraft_tpu.tpusim import coverage as _cov
+from madraft_tpu.tpusim.config import (
+    CoverageConfig,
+    Knobs,
+    SimConfig,
+    violation_names,
+)
 from madraft_tpu.tpusim.state import ClusterState, init_cluster
 from madraft_tpu.tpusim.step import step_cluster
 
@@ -260,6 +266,57 @@ def _constraint(mesh: Optional[Mesh]):
     return NamedSharding(mesh, P(mesh.axis_names[0]))
 
 
+def _retired_row(h, lane: int, wall: float, viol_total: int) -> dict:
+    """One retired-cluster report dict (the streaming JSONL schema) — ONE
+    builder for the plain and coverage pools, so the schema cannot drift
+    between them (the coverage pool appends its extra columns)."""
+    mask = int(h.violations[lane])
+    return {
+        "cluster_id": int(h.ids[lane]),
+        "ticks_run": int(h.ticks_run[lane]),
+        "violations": mask,
+        "violation_names": violation_names(mask),
+        "first_violation_tick": int(h.first_violation_tick[lane]),
+        "first_leader_tick": int(h.first_leader_tick[lane]),
+        "committed": int(h.committed[lane]),
+        "msg_count": int(h.msg_count[lane]),
+        "snap_installs": int(h.snap_installs[lane]),
+        "wall_s": round(wall, 3),
+        "violations_per_s": (
+            round(viol_total / wall, 3) if wall > 0 else None
+        ),
+    }
+
+
+def _pool_summary(n_clusters: int, horizon: int, chunk_ticks: int,
+                  lane_ticks: int, retired_total: int, viol_total: int,
+                  viol_clusters: list, union: int, effective: int,
+                  wall: float, next_id) -> dict:
+    """The pool summary dict — shared by the plain and coverage pools (the
+    coverage pool adds its ``coverage`` sub-dict on top)."""
+    dispatched = lane_ticks * n_clusters
+    return {
+        "lanes": n_clusters,
+        "horizon": horizon,
+        "chunk_ticks": chunk_ticks,
+        "lane_ticks": lane_ticks,
+        "ticks_dispatched": dispatched,
+        "retired": retired_total,
+        "retired_violating": viol_total,
+        "violating_clusters": viol_clusters[:16],
+        "violating_clusters_total": len(viol_clusters),
+        "violation_names": violation_names(union),
+        "effective_cluster_steps": int(effective),
+        "wall_s": round(wall, 3),
+        "steps_per_sec": round(dispatched / wall, 1) if wall > 0 else None,
+        "effective_steps_per_sec": (
+            round(effective / wall, 1) if wall > 0 else None
+        ),
+        "violations_per_s": round(viol_total / wall, 3) if wall > 0 else None,
+        "next_cluster_id": int(next_id),
+    }
+
+
 def default_chunk_ticks(horizon: int) -> int:
     """The pool's default chunk size: the horizon split into equal chunks
     no larger than CHUNK_TICKS, so lanes retire AT the horizon rather than
@@ -315,18 +372,45 @@ def _chunk_program(static_cfg: SimConfig, n_clusters: int):
     return jax.jit(run, donate_argnums=(0,))
 
 
+def _retire_and_reseed(states, ids, next_id, seed, horizon):
+    """The (seed, global_cluster_id) replay contract, ONE implementation for
+    the plain and coverage harvest programs: the retirement rule, monotone
+    left-to-right global-id assignment over retired lanes (deterministic, so
+    a pool run is exactly reproducible from its arguments), and the one-rule
+    key derivation — key = fold_in(PRNGKey(seed), global_id) for EVERY lane:
+    equal to the old key on kept lanes, the fresh key on refilled ones."""
+    retired = (states.violations != 0) | (states.tick >= horizon)
+    rank = jnp.cumsum(retired.astype(jnp.int32)) - 1
+    new_ids = jnp.where(retired, next_id + rank, ids)
+    base = jax.random.PRNGKey(seed)
+    new_keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(new_ids)
+    n_ret = retired.astype(jnp.int32).sum()
+    return retired, new_ids, new_keys, n_ret
+
+
+def _scatter_fresh(retired, fresh, states):
+    """Scatter freshly init_cluster-ed states into the retired lanes."""
+
+    def sel(f, s):
+        m = retired.reshape(retired.shape + (1,) * (f.ndim - 1))
+        return jnp.where(m, f, s)
+
+    return jax.tree.map(sel, fresh, states)
+
+
 @functools.lru_cache(maxsize=None)
 def _harvest_program(static_cfg: SimConfig, n_clusters: int,
                      mesh: Optional[Mesh]):
     """Harvest + refill, one compiled call (states donated): snapshot the
     small per-slot report arrays, then scatter freshly init_cluster-ed
     states into retired lanes under new global ids next_id, next_id+1, ...
-    (left-to-right over retired lanes — deterministic, so a pool run is
-    exactly reproducible from its arguments)."""
+    (see _retire_and_reseed)."""
     constraint = _constraint(mesh)
 
     def run(states, keys, ids, next_id, seed, kn, horizon):
-        retired = (states.violations != 0) | (states.tick >= horizon)
+        retired, new_ids, new_keys, n_ret = _retire_and_reseed(
+            states, ids, next_id, seed, horizon
+        )
         harvest = PoolHarvest(
             retired=retired,
             ids=ids,
@@ -338,12 +422,6 @@ def _harvest_program(static_cfg: SimConfig, n_clusters: int,
             snap_installs=states.snap_install_count,
             ticks_run=states.tick,
         )
-        rank = jnp.cumsum(retired.astype(jnp.int32)) - 1
-        new_ids = jnp.where(retired, next_id + rank, ids)
-        base = jax.random.PRNGKey(seed)
-        # key = fold_in(base, global_id) for EVERY lane: equal to the old key
-        # on kept lanes, the fresh key on refilled ones — one derivation rule
-        new_keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(new_ids)
         fresh = jax.vmap(
             functools.partial(init_cluster, static_cfg), in_axes=(0, None)
         )(new_keys, kn)
@@ -352,13 +430,7 @@ def _harvest_program(static_cfg: SimConfig, n_clusters: int,
                 fresh, jax.tree.map(lambda _: constraint, fresh)
             )
             new_keys = jax.lax.with_sharding_constraint(new_keys, constraint)
-
-        def sel(f, s):
-            m = retired.reshape(retired.shape + (1,) * (f.ndim - 1))
-            return jnp.where(m, f, s)
-
-        states_out = jax.tree.map(sel, fresh, states)
-        n_ret = retired.astype(jnp.int32).sum()
+        states_out = _scatter_fresh(retired, fresh, states)
         return states_out, new_keys, new_ids, next_id + n_ret, harvest
 
     return jax.jit(run, donate_argnums=(0,))
@@ -406,6 +478,7 @@ def run_pool(
     budget_seconds: Optional[float] = None,
     mesh: Optional[Mesh] = None,
     on_retired=None,
+    coverage: Optional[CoverageConfig] = None,
 ) -> dict:
     """Continuous fuzzing pool: chunk -> harvest -> refill until the budget
     is spent. ``n_clusters`` lanes stay resident on device; a lane retires
@@ -419,6 +492,13 @@ def run_pool(
     ticks (rounded up to whole chunks); ``budget_seconds`` stops at the
     first harvest past the wall-clock budget; neither given = one horizon.
     Returns a summary dict (counts, effective pre-violation steps, rates).
+
+    ``coverage`` (a ``config.CoverageConfig``) turns the pool into the
+    coverage-guided corpus scheduler (ROADMAP item 3): every tick each
+    lane's abstract-state fingerprint (coverage.py) updates a
+    device-resident seen-set, and the refill step is BIASED — see
+    ``_run_pool_coverage``. ``None`` (the default) is the historic pool,
+    byte-identical programs and reports.
     """
     if horizon < 1:
         raise ValueError(f"pool horizon must be >= 1 tick, got {horizon}")
@@ -426,6 +506,12 @@ def run_pool(
         chunk_ticks = default_chunk_ticks(horizon)
     if budget_ticks is None and budget_seconds is None:
         budget_ticks = horizon
+    if coverage is not None:
+        return _run_pool_coverage(
+            cfg, seed, n_clusters, horizon, coverage,
+            chunk_ticks=chunk_ticks, budget_ticks=budget_ticks,
+            budget_seconds=budget_seconds, mesh=mesh, on_retired=on_retired,
+        )
     static = cfg.static_key()
     kn = cfg.knobs()
     init = _pool_init_program(static, n_clusters, mesh)
@@ -477,21 +563,7 @@ def run_pool(
                 union |= mask
                 viol_clusters.append(int(h.ids[lane]))
             if on_retired is not None:
-                on_retired({
-                    "cluster_id": int(h.ids[lane]),
-                    "ticks_run": ticks_run,
-                    "violations": mask,
-                    "violation_names": violation_names(mask),
-                    "first_violation_tick": fvt,
-                    "first_leader_tick": int(h.first_leader_tick[lane]),
-                    "committed": int(h.committed[lane]),
-                    "msg_count": int(h.msg_count[lane]),
-                    "snap_installs": int(h.snap_installs[lane]),
-                    "wall_s": round(wall, 3),
-                    "violations_per_s": (
-                        round(viol_total / wall, 3) if wall > 0 else None
-                    ),
-                })
+                on_retired(_retired_row(h, lane, wall, viol_total))
         if budget_ticks is not None and lane_ticks >= budget_ticks:
             break
         if budget_seconds is not None and wall >= budget_seconds:
@@ -499,27 +571,273 @@ def run_pool(
     # in-flight lanes at shutdown are clean (violated => retired): their
     # ticks so far are honest pre-violation coverage
     effective += int(h.ticks_run[~h.retired].sum())
-    dispatched = lane_ticks * n_clusters
-    return {
-        "lanes": n_clusters,
-        "horizon": horizon,
-        "chunk_ticks": chunk_ticks,
-        "lane_ticks": lane_ticks,
-        "ticks_dispatched": dispatched,
-        "retired": retired_total,
-        "retired_violating": viol_total,
-        "violating_clusters": viol_clusters[:16],
-        "violating_clusters_total": len(viol_clusters),
-        "violation_names": violation_names(union),
-        "effective_cluster_steps": int(effective),
-        "wall_s": round(wall, 3),
-        "steps_per_sec": round(dispatched / wall, 1) if wall > 0 else None,
-        "effective_steps_per_sec": (
-            round(effective / wall, 1) if wall > 0 else None
+    return _pool_summary(n_clusters, horizon, chunk_ticks, lane_ticks,
+                         retired_total, viol_total, viol_clusters, union,
+                         effective, wall, next_id)
+
+
+# --------------------------------------------------------------------------
+# Coverage-guided pool (ROADMAP item 3; the abstraction lives in coverage.py).
+#
+# The pool's retire-and-refill loop IS the corpus scheduler — no new driver.
+# Coverage adds three device-resident pieces: a per-tick abstract-state
+# fingerprint folded inside the chunk program, a power-of-two seen-set
+# bitmap, and per-lane new-fingerprint counters that the harvest consumes to
+# BIAS the refill (productive lanes spawn knob-mutated children, the rest
+# draw fresh knob rows from the prior). Lanes therefore run HETEROGENEOUS
+# knobs, which needs the per-cluster knob layout (the measured 2.4x sweep
+# cliff — the price of guided search, paid only in coverage mode). All
+# coverage programs are SEPARATE cached programs: with coverage off, no
+# existing program's HLO — or warm persistent-cache entry — changes.
+# --------------------------------------------------------------------------
+
+
+class CovHarvest(NamedTuple):
+    """PoolHarvest plus the coverage columns (all PRE-refill)."""
+
+    retired: jax.Array
+    ids: jax.Array
+    violations: jax.Array
+    first_violation_tick: jax.Array
+    first_leader_tick: jax.Array
+    committed: jax.Array
+    msg_count: jax.Array
+    snap_installs: jax.Array
+    ticks_run: jax.Array
+    new_fps: jax.Array      # i32 [n]: new fingerprints this lane discovered
+    #                         since ITS refill (its whole lifetime)
+    refill_kind: jax.Array  # i32 [n]: how this lane's knobs were produced
+    #                         (coverage.REFILL_SEED / _FRESH / _MUTATE)
+    seen_bits: jax.Array    # i32 scalar: seen-set popcount after this chunk
+    knobs: Knobs            # per-lane knob rows (for JSONL + replay)
+
+
+@functools.lru_cache(maxsize=None)
+def _cov_chunk_program(static_cfg: SimConfig, n_clusters: int,
+                       ccfg: CoverageConfig):
+    """The coverage chunk: T ticks of the batched step under PER-LANE knob
+    rows, with every tick's post-step abstract-state fingerprint recorded in
+    the seen-set and credited to its lane's new-fingerprint counter. Two
+    lanes landing the same new bit in one tick both get credit
+    (deterministic; the alternative needs a per-tick segment reduction for
+    a tie nobody acts on). The state, bitmap, and counters are donated —
+    the pool's double-buffer discipline."""
+
+    def run(states, keys, kn_lanes, bitmap, new_fps, n_ticks):
+        def body(_, carry):
+            st, bm, nf = carry
+            st = jax.vmap(
+                functools.partial(step_cluster, static_cfg),
+                in_axes=(0, 0, 0),
+            )(st, keys, kn_lanes)
+            code = jax.vmap(functools.partial(_cov.abstract_code, ccfg))(st)
+            idx = _cov.bitmap_index(ccfg, static_cfg.n_nodes, code)
+            # a violated lane's post-violation states are waste, not
+            # coverage (the effective_cluster_steps convention): until the
+            # harvest retires it, it must neither set seen-set bits nor
+            # earn the productivity credit that biases refill
+            ok = st.violations == 0
+            nf = nf + (ok & ~bm[idx]).astype(jnp.int32)
+            bm = bm.at[idx].max(ok)
+            return st, bm, nf
+
+        return jax.lax.fori_loop(
+            0, n_ticks, body, (states, bitmap, new_fps)
+        )
+
+    return jax.jit(run, donate_argnums=(0, 3, 4))
+
+
+@functools.lru_cache(maxsize=None)
+def _cov_harvest_program(static_cfg: SimConfig, n_clusters: int,
+                         ccfg: CoverageConfig):
+    """Harvest + BIASED refill, one compiled call (states donated): same
+    retirement rule and monotone global-id scheme as _harvest_program, plus
+    the corpus-scheduler policy — a retiring lane that discovered new
+    fingerprints respawns with its knob row mutated
+    (coverage.refill_knobs), an unproductive one with a fresh prior draw.
+    With ``ccfg.guided`` False the refill keeps every lane at the base knob
+    row (measurement-only mode: trajectories identical to the plain pool —
+    the random A/B baseline and the first-generation golden guard)."""
+
+    def run(states, keys, ids, kn_lanes, kinds, new_fps, bitmap,
+            next_id, seed, base_kn, horizon):
+        retired, new_ids, new_keys, n_ret = _retire_and_reseed(
+            states, ids, next_id, seed, horizon
+        )
+        harvest = CovHarvest(
+            retired=retired,
+            ids=ids,
+            violations=states.violations,
+            first_violation_tick=states.first_violation_tick,
+            first_leader_tick=states.first_leader_tick,
+            committed=states.shadow_len,
+            msg_count=states.msg_count,
+            snap_installs=states.snap_install_count,
+            ticks_run=states.tick,
+            new_fps=new_fps,
+            refill_kind=kinds,
+            seen_bits=jnp.sum(bitmap, dtype=jnp.int32),
+            knobs=kn_lanes,
+        )
+        if ccfg.guided:
+            productive = retired & (new_fps > 0)
+            kn_new, drawn = _cov.refill_knobs(
+                ccfg, kn_lanes, base_kn, retired, productive, new_ids, seed
+            )
+            kinds_new = jnp.where(retired, drawn, kinds)
+        else:
+            kn_new, kinds_new = kn_lanes, kinds  # base rows forever
+        fresh = jax.vmap(
+            functools.partial(init_cluster, static_cfg), in_axes=(0, 0)
+        )(new_keys, kn_new)
+        states_out = _scatter_fresh(retired, fresh, states)
+        new_fps_out = jnp.where(retired, 0, new_fps)
+        return (states_out, new_keys, new_ids, kn_new, kinds_new,
+                new_fps_out, next_id + n_ret, harvest)
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+def _run_pool_coverage(
+    cfg: SimConfig,
+    seed: int,
+    n_clusters: int,
+    horizon: int,
+    ccfg: CoverageConfig,
+    *,
+    chunk_ticks: int,
+    budget_ticks: Optional[int],
+    budget_seconds: Optional[float],
+    mesh: Optional[Mesh],
+    on_retired,
+) -> dict:
+    """run_pool's coverage-guided body (see run_pool for the contract).
+
+    Extra report surface: each retired-cluster row carries
+    ``new_fingerprints`` (the lane's lifetime discovery count), ``refill``
+    ("seed" | "fresh" | "mutate" — how its knob row was produced) and
+    ``knobs`` (the mutable storm-knob values it ran, full f32 precision —
+    feed them to ``replay_cluster(..., knobs=row["knobs"])`` for bit-exact
+    replay); the summary gains a ``coverage`` dict with the seen-set totals
+    and the per-generation discovery curve.
+    """
+    if mesh is not None:
+        raise ValueError(
+            "the coverage pool is single-device for now (the seen-set "
+            "bitmap is one shared array; ROADMAP item 1 owns the sharded "
+            "pool) — drop mesh= or coverage="
+        )
+    static = cfg.static_key()
+    base_kn = cfg.knobs()
+    init = _pool_init_program(static, n_clusters, None)
+    # the chunk only reads the fingerprint fields — cache it on those, so
+    # the A/B's guided/random legs share one compiled chunk executable
+    chunk = _cov_chunk_program(static, n_clusters, ccfg.fingerprint_key())
+    harv = _cov_harvest_program(static, n_clusters, ccfg)
+    seed_u = jnp.asarray(seed, jnp.uint32)
+    hz = jnp.asarray(horizon, jnp.int32)
+    ct = jnp.asarray(chunk_ticks, jnp.int32)
+
+    def fresh_carry():
+        states, keys, ids = init(seed_u, base_kn, jnp.asarray(0, jnp.int32))
+        kn_lanes = base_kn.broadcast(n_clusters)
+        kinds = jnp.full((n_clusters,), _cov.REFILL_SEED, jnp.int32)
+        new_fps = jnp.zeros((n_clusters,), jnp.int32)
+        bitmap = jnp.zeros((ccfg.bitmap_bits,), jnp.bool_)
+        return states, keys, ids, kn_lanes, kinds, new_fps, bitmap
+
+    # warm all programs outside the timed window (run_pool convention; the
+    # tick count is a runtime bound so 1 tick compiles the real executables)
+    ws, wk, wi, wkn, wkd, wnf, wbm = fresh_carry()
+    ws, wbm, wnf = chunk(ws, wk, wkn, wbm, wnf, jnp.asarray(1, jnp.int32))
+    next_id = jnp.asarray(n_clusters, jnp.int32)
+    jax.block_until_ready(
+        harv(ws, wk, wi, wkn, wkd, wnf, wbm, next_id, seed_u, base_kn,
+             hz)[7].retired
+    )
+    states, keys, ids, kn_lanes, kinds, new_fps, bitmap = fresh_carry()
+    next_id = jnp.asarray(n_clusters, jnp.int32)
+    t0 = time.perf_counter()
+    lane_ticks = 0
+    retired_total = 0
+    viol_total = 0
+    effective = 0
+    union = 0
+    viol_clusters: list = []
+    wall = 0.0
+    h = None
+    seen_prev = 0
+    new_fp_per_gen: list = []
+    refills_mutated = 0
+    refills_fresh = 0
+    lane_new_fps_total = 0
+    while True:
+        states, bitmap, new_fps = chunk(
+            states, keys, kn_lanes, bitmap, new_fps, ct
+        )
+        lane_ticks += chunk_ticks
+        (states, keys, ids, kn_lanes, kinds, new_fps, next_id,
+         h_dev) = harv(states, keys, ids, kn_lanes, kinds, new_fps,
+                       bitmap, next_id, seed_u, base_kn, hz)
+        h = jax.tree.map(np.asarray, h_dev)
+        wall = time.perf_counter() - t0
+        seen_now = int(h.seen_bits)
+        new_fp_per_gen.append(seen_now - seen_prev)
+        seen_prev = seen_now
+        for lane in np.nonzero(h.retired)[0]:
+            mask = int(h.violations[lane])
+            fvt = int(h.first_violation_tick[lane])
+            ticks_run = int(h.ticks_run[lane])
+            retired_total += 1
+            effective += fvt if mask else ticks_run
+            lane_new_fps_total += int(h.new_fps[lane])
+            if mask:
+                viol_total += 1
+                union |= mask
+                viol_clusters.append(int(h.ids[lane]))
+            if on_retired is not None:
+                row = _retired_row(h, lane, wall, viol_total)
+                row["new_fingerprints"] = int(h.new_fps[lane])
+                row["refill"] = _cov.REFILL_NAMES[int(h.refill_kind[lane])]
+                row["knobs"] = {
+                    name: float(getattr(h.knobs, name)[lane])
+                    for name in _cov.MUTABLE_KNOBS
+                }
+                on_retired(row)
+        if budget_ticks is not None and lane_ticks >= budget_ticks:
+            break
+        if budget_seconds is not None and wall >= budget_seconds:
+            break
+        if ccfg.guided:
+            # counted only when the loop CONTINUES: the final harvest's
+            # refilled children never run a tick, and the summary's
+            # refills_* claim to record how lanes were actually spent
+            productive = h.retired & (h.new_fps > 0)
+            refills_mutated += int(productive.sum())
+            refills_fresh += int((h.retired & ~productive).sum())
+    effective += int(h.ticks_run[~h.retired].sum())
+    lane_new_fps_total += int(h.new_fps[~h.retired].sum())
+    summary = _pool_summary(n_clusters, horizon, chunk_ticks, lane_ticks,
+                            retired_total, viol_total, viol_clusters, union,
+                            effective, wall, next_id)
+    summary["coverage"] = {
+        "bitmap_bits": ccfg.bitmap_bits,
+        "identity": _cov.identity_mapped(cfg.n_nodes, ccfg),
+        "guided": ccfg.guided,
+        "seen_fingerprints": seen_prev,
+        "new_fingerprints_per_s": (
+            round(seen_prev / wall, 2) if wall > 0 else None
         ),
-        "violations_per_s": round(viol_total / wall, 3) if wall > 0 else None,
-        "next_cluster_id": int(next_id),
+        "lane_new_fps_total": lane_new_fps_total,
+        "generations": len(new_fp_per_gen),
+        # truncated like violating_clusters[:16]; "generations" carries the
+        # full count so a consumer can detect the cut
+        "new_fp_per_gen": new_fp_per_gen[:64],
+        "refills_mutated": refills_mutated,
+        "refills_fresh": refills_fresh,
     }
+    return summary
 
 
 def _validate_knobs(knobs) -> None:
@@ -759,12 +1077,63 @@ def _replay_program(static_cfg: SimConfig):
     return jax.jit(run)
 
 
+def resolve_knobs(cfg: SimConfig, knobs) -> Knobs:
+    """``cfg.knobs()`` with an optional override merged on top and validated.
+
+    ``knobs``: ``None``, a full ``config.Knobs``, or a mapping of field
+    name -> value (e.g. a coverage-pool JSONL row's ``"knobs"`` object).
+    Overrides pass ``_validate_knobs`` like every other entry point — a
+    hand-edited row with an out-of-range probability is rejected eagerly,
+    not silently run. Shared by ``replay_cluster`` and
+    ``trace.replay_cluster_traced`` so the replay and explain surfaces
+    apply a mutated lane's knob row identically."""
+    kn = cfg.knobs()
+    if knobs is None:
+        return kn
+    if isinstance(knobs, Knobs):
+        kn = knobs
+    else:
+        if not isinstance(knobs, dict):
+            # --knobs-json '0.5' or a bare string must fail with a named
+            # rejection, not an opaque TypeError / nonsense field list
+            raise ValueError(
+                "knobs override must be a JSON object / mapping of field "
+                f"-> value, got {type(knobs).__name__}"
+            )
+        unknown = set(knobs) - set(kn._fields)
+        if unknown:
+            raise ValueError(f"unknown knob fields: {sorted(unknown)}")
+        coerced = {}
+        for k, v in knobs.items():
+            dt = getattr(kn, k).dtype
+            if jnp.issubdtype(dt, jnp.integer) and v != int(v):
+                # bit-exact replay must not silently truncate a
+                # hand-edited row (the _validate_knobs eager convention)
+                raise ValueError(
+                    f"knob {k!r} is integer-valued; {v!r} would be "
+                    f"silently truncated"
+                )
+            coerced[k] = jnp.asarray(v, dt)
+        kn = kn._replace(**coerced)
+    _validate_knobs(kn)
+    return kn
+
+
 def replay_cluster(
-    cfg: SimConfig, seed: int, cluster_id: int, n_ticks: int
+    cfg: SimConfig, seed: int, cluster_id: int, n_ticks: int, knobs=None
 ) -> ClusterState:
-    """Re-run a single cluster (e.g. a violating one) for inspection/replay."""
+    """Re-run a single cluster (e.g. a violating one) for inspection/replay.
+
+    ``knobs``: optional dynamic-knob override (see ``resolve_knobs``). This
+    is how a coverage-pool hit with a mutated knob row replays bit-exactly
+    (the pool's JSONL row carries the row under ``"knobs"``); it reuses the
+    SAME compiled replay program either way, because knobs were always
+    runtime scalars — exactly like replaying a sweep cell needs the cell's
+    knob values, the (seed, cluster_id) PRNG-stream contract itself is
+    knob-independent."""
     prog = _replay_program(cfg.static_key())
+    kn = resolve_knobs(cfg, knobs)
     return jax.block_until_ready(
-        prog(jnp.asarray(cluster_id, jnp.int32), cfg.knobs(),
+        prog(jnp.asarray(cluster_id, jnp.int32), kn,
              jnp.asarray(n_ticks, jnp.int32), jnp.asarray(seed, jnp.uint32))
     )

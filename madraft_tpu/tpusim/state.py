@@ -150,6 +150,36 @@ def durable_after_append(s: ClusterState, new_len: jax.Array) -> jax.Array:
     return jnp.where(new_len > s.log_len, new_len, s.durable_len)
 
 
+def abstract_node_tuple(
+    s: ClusterState, term_rank_levels: int, commit_delta_levels: int
+) -> tuple:
+    """The per-node abstract-state observation the coverage subsystem
+    fingerprints (coverage.py, ROADMAP item 3) — defined here, next to the
+    state it reads, so extending the abstraction means touching this tuple
+    rather than the engine. Each component is quantized to a tiny static
+    alphabet so the folded code space of a small cluster stays enumerable:
+
+    - role:          0 follower / 1 candidate / 2 leader
+    - alive:         0 / 1
+    - term-rank:     #nodes with a strictly smaller term, clipped to
+                     ``term_rank_levels - 1`` — captures WHO is ahead in the
+                     term order, not by how much (absolute terms grow
+                     without bound; their order pattern is what
+                     distinguishes interleavings)
+    - commit-delta:  ``commit - min(commit)`` clipped to
+                     ``commit_delta_levels - 1`` — who lags the commit
+                     frontier (the Figure-8 family lives in these lags)
+
+    Returns four i32 ``[n]`` arrays (vmap adds the lane axis).
+    """
+    rank = jnp.clip(
+        jnp.sum(s.term[None, :] < s.term[:, None], axis=1).astype(I32),
+        0, term_rank_levels - 1,
+    )
+    delta = jnp.clip(s.commit - jnp.min(s.commit), 0, commit_delta_levels - 1)
+    return s.role, s.alive.astype(I32), rank, delta
+
+
 def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
     """Fresh cluster at tick 0 with randomized election timers (raft.rs:260-263).
 

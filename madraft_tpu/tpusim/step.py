@@ -43,6 +43,12 @@ and the planted "ack_before_fsync" bug strips the handler-reply ones):
                   state every fsync_every ticks (staggered); 1 = the
                   historic always-durable model
 
+The coverage subsystem (coverage.py) fingerprints the POST-tick state this
+function returns — its abstract-state code (state.abstract_node_tuple) is a
+pure observation computed outside this function by the engine's coverage
+chunk program, so the tick itself carries zero coverage cost and its traced
+program (and every cached executable) is byte-identical with coverage off.
+
 The log is a CANONICAL RING (see state.py): absolute (1-based) index ``a``
 always lives in lane ``(a - 1) & (cap - 1)``; ``base`` (snapshot boundary) and
 ``log_len``/``commit``/next/match indices are absolute, and the live window is

@@ -146,7 +146,7 @@ def _traced_program(static_cfg: SimConfig, n_ticks: int):
 
 
 def replay_cluster_traced(
-    cfg: SimConfig, seed: int, cluster_id: int, n_ticks: int
+    cfg: SimConfig, seed: int, cluster_id: int, n_ticks: int, knobs=None
 ):
     """Re-run ONE cluster with the flight recorder on.
 
@@ -154,10 +154,17 @@ def replay_cluster_traced(
     (bit-identical to ``engine.replay_cluster`` — same step, same PRNG
     stream) and a :class:`TickRecord` of host numpy arrays with a leading
     ``[n_ticks]`` axis.
+
+    ``knobs``: optional dynamic-knob override (``engine.resolve_knobs``) —
+    a coverage-pool row's mutated knob row must be applied here too, or the
+    explain timeline would silently decode a DIFFERENT execution (base-knob
+    Bernoulli thresholds) than the one that violated.
     """
+    from madraft_tpu.tpusim.engine import resolve_knobs
+
     prog = _traced_program(cfg.static_key(), int(n_ticks))
     final, rec = jax.block_until_ready(
-        prog(jnp.asarray(cluster_id, I32), cfg.knobs(),
+        prog(jnp.asarray(cluster_id, I32), resolve_knobs(cfg, knobs),
              jnp.asarray(seed, jnp.uint32))
     )
     return final, jax.tree.map(np.asarray, rec)

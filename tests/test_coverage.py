@@ -1,0 +1,216 @@
+"""Coverage-guided schedule search (ROADMAP item 3): fingerprint determinism
+across runs and devices, structural validity of reached codes against the
+offline enumerator, seen-set saturation, first-generation bit-identity with
+the coverage-off pool (the golden-guard property on the coverage path),
+mutation-refill replay bit-exactness across refill generations, and the
+guided-beats-random reached-state A/B on the ground-truth config."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madraft_tpu.tpusim import coverage as cov
+from madraft_tpu.tpusim.config import (
+    CoverageConfig,
+    coverage_ground_truth,
+)
+from madraft_tpu.tpusim.engine import (
+    make_fuzz_fn,
+    replay_cluster,
+    run_pool,
+)
+
+GT_CFG, GT_CCFG, GT_HORIZON = coverage_ground_truth()
+
+_CACHE = {}
+
+
+def _pooled(key, **kw):
+    """One pool run per distinct argument set (results are pure functions of
+    the arguments — determinism is itself pinned by the replay test)."""
+    if key not in _CACHE:
+        rows = []
+        summary = run_pool(on_retired=rows.append, **kw)
+        _CACHE[key] = (rows, summary)
+    return _CACHE[key]
+
+
+def _guided(budget_mult=8, seed=7, ccfg=GT_CCFG):
+    return _pooled(
+        ("guided", seed, budget_mult, ccfg), cfg=GT_CFG, seed=seed,
+        n_clusters=16, horizon=GT_HORIZON,
+        budget_ticks=GT_HORIZON * budget_mult, coverage=ccfg,
+    )
+
+
+def test_fingerprint_deterministic_across_runs_and_devices():
+    # the fingerprint is a pure function of the state: two jit invocations
+    # and both virtual devices must produce identical codes
+    fn = make_fuzz_fn(GT_CFG, 8, 48)
+    final = jax.block_until_ready(fn(3))
+    code_fn = jax.jit(jax.vmap(lambda s: cov.abstract_code(GT_CCFG, s)))
+    a = np.asarray(code_fn(final))
+    b = np.asarray(code_fn(jax.tree.map(jnp.asarray, final)))
+    np.testing.assert_array_equal(a, b)
+    devs = jax.devices()
+    if len(devs) >= 2:
+        on_dev1 = jax.device_put(final, devs[1])
+        c = np.asarray(code_fn(on_dev1))
+        np.testing.assert_array_equal(a, c)
+
+
+def test_reached_codes_are_enumerated_and_identity_mapped():
+    # every code a real run produces must be a member of the enumerated
+    # structural state space (the enumerator is a sound superset), and in
+    # identity mode the bitmap index IS the code
+    assert cov.identity_mapped(GT_CFG.n_nodes, GT_CCFG)
+    enumerated = set(
+        cov.enumerate_abstract_codes(GT_CFG.n_nodes, GT_CCFG).tolist()
+    )
+    fn = make_fuzz_fn(GT_CFG, 8, 48)
+    final = jax.block_until_ready(fn(3))
+    codes = np.asarray(
+        jax.vmap(lambda s: cov.abstract_code(GT_CCFG, s))(final)
+    )
+    assert set(codes.tolist()) <= enumerated
+    idx = np.asarray(cov.bitmap_index(GT_CCFG, GT_CFG.n_nodes,
+                                      jnp.asarray(codes)))
+    np.testing.assert_array_equal(idx, codes.astype(np.int32))
+    # the hashed (non-identity) path stays inside the bitmap
+    small = GT_CCFG.replace(bitmap_bits=64)
+    hidx = np.asarray(cov.bitmap_index(small, GT_CFG.n_nodes,
+                                       jnp.asarray(codes)))
+    assert ((hidx >= 0) & (hidx < 64)).all()
+
+
+def test_seen_set_saturation():
+    # a deliberately tiny bitmap must saturate: the popcount never exceeds
+    # the bitmap, per-generation discoveries account for it exactly, and
+    # once every bit is set later generations discover nothing
+    ccfg = GT_CCFG.replace(bitmap_bits=64)
+    _, summary = _guided(budget_mult=10, ccfg=ccfg)
+    c = summary["coverage"]
+    assert not c["identity"]
+    assert 0 < c["seen_fingerprints"] <= 64
+    gens = c["new_fp_per_gen"]
+    assert sum(gens) == c["seen_fingerprints"]
+    running = np.cumsum(gens)
+    after_full = np.asarray(gens)[1:][running[:-1] >= 64]
+    assert (after_full == 0).all(), (
+        f"seen-set kept 'discovering' after saturation: {gens}"
+    )
+    assert running[-1] >= 56, f"64-bit map should nearly fill, got {gens}"
+
+
+def test_first_generation_bit_identical_to_coverage_off():
+    # horizon == chunk == budget: one generation, no refill ever applied —
+    # the coverage pool's retired-cluster reports must match the plain
+    # pool's bit-identically (the per-cluster knob layout changes the HLO,
+    # not the numbers), and every gen-1 lane runs the base knob row
+    rows_off = []
+    run_pool(GT_CFG, 5, 16, GT_HORIZON, chunk_ticks=GT_HORIZON,
+             budget_ticks=GT_HORIZON, on_retired=rows_off.append)
+    rows_cov = []
+    run_pool(GT_CFG, 5, 16, GT_HORIZON, chunk_ticks=GT_HORIZON,
+             budget_ticks=GT_HORIZON, coverage=GT_CCFG,
+             on_retired=rows_cov.append)
+    assert len(rows_off) == len(rows_cov) == 16
+    skip = {"wall_s", "violations_per_s"}
+    base_kn = GT_CFG.knobs()
+    for off, con in zip(rows_off, rows_cov):
+        for k, want in off.items():
+            if k in skip:
+                continue
+            assert con[k] == want, f"coverage drift in gen-1 field {k!r}"
+        assert con["refill"] == "seed"
+        assert con["new_fingerprints"] > 0
+        for name, v in con["knobs"].items():
+            assert v == float(np.asarray(getattr(base_kn, name)))
+
+
+def test_mutation_refill_replay_bit_exact_across_generations():
+    # the replay contract for mutated lanes: every retired cluster —
+    # including knob-mutated and fresh-drawn descendants, >= 2 refill
+    # generations deep — reproduces bit-exactly through
+    # replay_cluster(seed, global_id, knobs=row["knobs"])
+    rows, summary = _guided()
+    gens = {r["cluster_id"] // 16 for r in rows if r["refill"] != "seed"}
+    assert len(gens) >= 2, f"need >= 2 refill generations, got {gens}"
+    kinds = {r["refill"] for r in rows}
+    assert "mutate" in kinds and "fresh" in kinds, kinds
+    assert summary["coverage"]["refills_mutated"] > 0
+    assert summary["coverage"]["refills_fresh"] > 0
+    picked = [r for r in rows if r["refill"] == "mutate"][:4]
+    picked += [r for r in rows if r["refill"] == "fresh"][:2]
+    picked += [r for r in rows if r["violations"]][:2]
+    for r in picked:
+        st = replay_cluster(GT_CFG, 7, r["cluster_id"], r["ticks_run"],
+                            knobs=r["knobs"])
+        assert int(st.violations) == r["violations"]
+        assert int(st.first_violation_tick) == r["first_violation_tick"]
+        assert int(st.shadow_len) == r["committed"]
+        assert int(st.msg_count) == r["msg_count"]
+    # the explain surface applies the same knob row: the traced replay of a
+    # MUTATED lane must reproduce the untraced one bit-identically (base
+    # knobs would decode a different execution)
+    from madraft_tpu.tpusim.trace import replay_cluster_traced
+
+    r = picked[0]
+    final, _ = replay_cluster_traced(GT_CFG, 7, r["cluster_id"],
+                                     r["ticks_run"], knobs=r["knobs"])
+    assert int(final.violations) == r["violations"]
+    assert int(final.msg_count) == r["msg_count"]
+    assert int(final.shadow_len) == r["committed"]
+
+
+def test_mutated_knob_rows_respect_the_prior():
+    # mutation and fresh draws stay probabilities, and a knob the base
+    # profile disabled is never turned on by the search
+    rows, _ = _guided()
+    base_kn = GT_CFG.knobs()
+    for r in rows:
+        for name, v in r["knobs"].items():
+            assert 0.0 <= v <= 1.0, (name, v)
+            if float(np.asarray(getattr(base_kn, name))) == 0.0:
+                assert v == 0.0, f"{name} enabled by mutation"
+
+
+def test_guided_reaches_more_states_than_random():
+    # the ground-truth A/B (bench.py's exit criterion, pinned small): equal
+    # lanes and tick budget, guided must reach strictly more enumerated
+    # abstract states than the uniform-random baseline — and both must be
+    # sane fractions of the enumerated space
+    total = len(cov.enumerate_abstract_codes(GT_CFG.n_nodes, GT_CCFG))
+    _, guided = _guided(budget_mult=20)
+    _, random_ = _pooled(
+        ("random", 7, 20), cfg=GT_CFG, seed=7, n_clusters=16,
+        horizon=GT_HORIZON, budget_ticks=GT_HORIZON * 20,
+        coverage=GT_CCFG.replace(guided=False),
+    )
+    gs = guided["coverage"]["seen_fingerprints"]
+    rs = random_["coverage"]["seen_fingerprints"]
+    assert 0 < rs < gs <= total, (gs, rs, total)
+    assert random_["coverage"]["refills_mutated"] == 0
+    assert random_["coverage"]["guided"] is False
+
+
+def test_coverage_config_validation_and_mesh_gate():
+    with pytest.raises(ValueError, match="power of two"):
+        CoverageConfig(bitmap_bits=100)
+    with pytest.raises(ValueError, match=">= 2"):
+        CoverageConfig(term_rank_levels=1)
+    with pytest.raises(ValueError, match="mut_span"):
+        CoverageConfig(mut_span=1.0)
+    with pytest.raises(ValueError, match="enumerate"):
+        cov.enumerate_abstract_codes(5, CoverageConfig())
+    if len(jax.devices()) >= 2:
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("clusters",))
+        with pytest.raises(ValueError, match="single-device"):
+            run_pool(GT_CFG, 1, 16, GT_HORIZON, coverage=GT_CCFG, mesh=mesh)
+    with pytest.raises(ValueError, match="unknown knob"):
+        replay_cluster(GT_CFG, 1, 0, 8, knobs={"not_a_knob": 1.0})
+    with pytest.raises(ValueError, match="loss_prob"):
+        # out-of-range overrides are rejected eagerly (_validate_knobs),
+        # not silently run as a bogus "bit-exact" replay
+        replay_cluster(GT_CFG, 1, 0, 8, knobs={"loss_prob": 1.5})

@@ -2,10 +2,14 @@
 
 Each C++ test runs in its own subprocess with a fixed seed (failures print the
 seed for exact replay, reference README.md:42-55). The binary is (re)built on
-demand with cmake+ninja.
+demand with cmake+ninja; on containers without the cmake toolchain the whole
+module SKIPS cleanly (one skipped parametrization + skipped watchdog tests)
+instead of erroring at collection — ``--continue-on-collection-errors`` must
+not be load-bearing for tier-1.
 """
 
 import pathlib
+import shutil
 import subprocess
 
 import pytest
@@ -14,6 +18,17 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 BUILD = ROOT / "build"
 BINARY = BUILD / "madtpu_tests"
 SEED = "12345"
+
+
+def _unavailable_reason():
+    """Non-None when the C++ suite cannot run here: the cmake/ninja
+    toolchain is absent (this container ships only g++ — the in-process
+    bridge tests still run via simcore's direct-g++ fallback, but this
+    module's full test binary is a cmake build)."""
+    missing = [t for t in ("cmake", "ninja") if shutil.which(t) is None]
+    if missing:
+        return f"C++ suite needs cmake+ninja; missing: {', '.join(missing)}"
+    return None
 
 
 def _build():
@@ -43,11 +58,35 @@ def _list_tests():
 
 def pytest_generate_tests(metafunc):
     if "cpp_test_name" in metafunc.fixturenames:
-        metafunc.parametrize("cpp_test_name", _list_tests())
+        reason = _unavailable_reason()
+        if reason is None:
+            try:
+                names = _list_tests()
+            except OSError as e:
+                # missing/unrunnable binary only — a cmake build that RUNS
+                # and fails (CalledProcessError) must FAIL the suite, not
+                # skip it: skipping would silently green a broken C++ change
+                # on boxes that do have the toolchain
+                reason = f"C++ test binary unavailable: {e}"
+        if reason is not None:
+            # one visibly-skipped parametrization, not a collection error
+            names = [pytest.param("toolchain-missing",
+                                  marks=pytest.mark.skip(reason=reason))]
+        metafunc.parametrize("cpp_test_name", names)
+
+
+def _ensure_built_or_skip():
+    reason = _unavailable_reason()
+    if reason is not None:
+        pytest.skip(reason)
+    try:
+        _ensure_built()
+    except OSError as e:  # see pytest_generate_tests: build FAILURES fail
+        pytest.skip(f"C++ test binary unavailable: {e}")
 
 
 def test_cpp(cpp_test_name):
-    _ensure_built()
+    _ensure_built_or_skip()
     proc = subprocess.run(
         [str(BINARY), cpp_test_name],
         env={"MADTPU_TEST_SEED": SEED, "PATH": "/usr/bin:/bin"},
@@ -64,7 +103,7 @@ def test_watchdog_names_the_wedged_test():
     panic + a virtual-time cap) must convert a wedged test into a crisp
     failure naming the test and both clocks — not an opaque runner timeout
     (the seed-7036 lesson, PERF.md round 5)."""
-    _ensure_built()
+    _ensure_built_or_skip()
     proc = subprocess.run(
         [str(BINARY), "wdog_selftest_wedge"],
         env={
@@ -81,7 +120,7 @@ def test_watchdog_names_the_wedged_test():
 def test_sigalrm_backstop_names_cpu_bound_hang():
     """A CPU-bound hang never returns to the event loop, so only the runner's
     SIGALRM backstop can catch it — and it must still name the test."""
-    _ensure_built()
+    _ensure_built_or_skip()
     proc = subprocess.run(
         [str(BINARY), "wdog_selftest_spin"],
         env={

@@ -198,6 +198,23 @@ def test_fuzz_report_matches_golden():
             )
 
 
+def test_pool_summary_matches_golden():
+    # The pool-path extension of the golden guard (PR 6): the fixed-seed
+    # pool run's deterministic summary fields must stay bit-identical —
+    # proof that the coverage subsystem's separate programs left the
+    # coverage-OFF chunk/harvest/refill path (HLO and output) unchanged.
+    # Wall-clock keys are excluded by construction (the golden records only
+    # deterministic fields).
+    rc, out = run_cli(_GOLDEN["pool"]["argv"])
+    assert rc == 1, "the planted-bug pool leg must exit 1"
+    summary = out[-1]
+    for key, want in _GOLDEN["pool"]["summary"].items():
+        assert summary[key] == want, (
+            f"pool summary field {key!r} drifted: "
+            f"{summary[key]!r} != golden {want!r}"
+        )
+
+
 # ------------------------------------------------------- C++ bridge leg
 def _simcore_or_skip():
     from madraft_tpu import simcore
