@@ -288,10 +288,128 @@ def bench_pool(n_lanes: int, budget_ticks: int) -> dict:
         "pool_viol_per_chip_s": round(pool_vps, 4),
         "pool_steps_per_sec": summary["steps_per_sec"],
         "pool_effective_steps_per_sec": summary["effective_steps_per_sec"],
+        # the pipeline telemetry (ISSUE 7): how much host-side
+        # harvest/emit wall hid under device execution, vs the host-caused
+        # wall between device dispatches and the device-bound share
+        "pool_dispatch_gap_s": summary["dispatch_gap_s"],
+        "pool_device_wait_s": summary["device_wait_s"],
+        "pool_host_overlap_s": summary["host_overlap_s"],
         "viol_per_chip_s_ratio": (
             round(pool_vps / fuzz_vps, 3) if fuzz_vps else None
         ),
     }
+
+
+def _pool_scaling_child(n_lanes: int, budget_ticks: int) -> dict:
+    """The measured legs of bench_pool_scaling, BOTH run inside the one
+    2-virtual-device process: the same (seed, lanes, horizon, budget) pool
+    at devices=1 vs devices=2. Under the lane-partitioned id scheme both
+    legs examine the identical cluster population (the device-count-
+    invariance contract), so the row also double-checks report-multiset
+    equality.
+
+    Measurement framing (deliberate): with the device count forced, each
+    virtual device owns an equal slice of host threads, so the devices=1
+    leg runs on ONE device's worth of resources — per-device resources are
+    held constant while the device count varies, which is what chip
+    scaling means. An unforced 1-device process would hand the baseline
+    the whole host (XLA's intra-op pool spans every core) and understate
+    scaling; conversely the virtual devices share one memory system, which
+    OVERSTATES nothing at small lanes but saturates at large ones — the
+    setup string says so, and the real-chip row is queued behind the
+    tunnel."""
+    from madraft_tpu.tpusim.config import storm_profiles
+    from madraft_tpu.tpusim.engine import run_pool
+
+    prof, _, rec_ticks, _bugs = storm_profiles()["durability"]
+    cfg = prof.replace(bug="ack_before_fsync")
+    horizon = min(rec_ticks, budget_ticks)
+
+    def leg(devs):
+        rows = []
+        s = run_pool(cfg, 12345, n_lanes, horizon, budget_ticks=budget_ticks,
+                     devices=devs, on_retired=rows.append)
+        key = sorted(
+            (r["cluster_id"],
+             tuple(sorted((k, str(v)) for k, v in r.items()
+                          if k not in ("wall_s", "violations_per_s"))))
+            for r in rows
+        )
+        return s, key
+
+    s1, k1 = leg(1)
+    s2, k2 = leg(2)
+    v1, v2 = s1["retired_violating"], s2["retired_violating"]
+    w1, w2 = s1["wall_s"], s2["wall_s"]
+    speedup = round(w1 / w2, 3) if w2 > 0 else None
+    return {
+        "profile": "durability",
+        "bug": "ack_before_fsync",
+        "lanes": n_lanes,
+        "budget_ticks": budget_ticks,
+        "horizon": horizon,
+        "setup": "both legs in one 2-virtual-device CPU process (equal "
+                 "host threads per device — the per-chip-resources-"
+                 "constant proxy; real-chip scaling is queued behind the "
+                 "axon tunnel, TUNNEL_STATUS.jsonl)",
+        "reports_identical": k1 == k2,
+        "dev1_violations": v1,
+        "dev2_violations": v2,
+        "dev1_wall_s": w1,
+        "dev2_wall_s": w2,
+        "dev1_viol_per_chip_s": round(v1 / w1, 4) if w1 > 0 else None,
+        # 2-device chip-seconds = wall * 2: per-chip parity at ~1.0 means
+        # near-linear scaling (both legs retire the SAME violations)
+        "dev2_viol_per_chip_s": (
+            round(v2 / (w2 * 2), 4) if w2 > 0 else None
+        ),
+        "speedup": speedup,
+        "scaling_efficiency": (
+            round(speedup / 2, 3) if speedup is not None else None
+        ),
+        "dev1_dispatch_gap_s": s1["dispatch_gap_s"],
+        "dev1_host_overlap_s": s1["host_overlap_s"],
+        "dev2_dispatch_gap_s": s2["dispatch_gap_s"],
+        "dev2_host_overlap_s": s2["host_overlap_s"],
+    }
+
+
+def bench_pool_scaling(n_lanes: int, budget_ticks: int) -> dict:
+    """Sharded-pool scaling A/B (ROADMAP item 1): violations per
+    chip-second at 1 vs 2 devices, same seed and budget, plus the
+    report-multiset equality check the lane-partitioned id scheme
+    guarantees. Runs in a SUBPROCESS pinned to 2 virtual CPU devices so
+    the parent bench keeps its own device configuration (forcing extra
+    host devices costs ~1.5x on every single-device region — the PR-3 CI
+    finding); the on-chip 1->8 row is queued behind the axon tunnel."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    # timeout scales with the two pool runs' work but stays small at smoke
+    # scale, so a hung child inside ci.sh's 600 s bench envelope still
+    # yields a labeled error row instead of the parent being SIGTERMed
+    timeout_s = 240 + n_lanes * budget_ticks // 2000
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--pool-scaling-child", str(n_lanes), str(budget_ticks)],
+            env=env, capture_output=True, text=True, timeout=timeout_s,
+        )
+        if out.returncode != 0:
+            return {"error": f"child rc {out.returncode}",
+                    "stderr": out.stderr[-800:]}
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # a lost row must be labeled, not a crash
+        return {"error": str(e)}
 
 
 def bench_coverage(n_lanes: int, budget_ticks: int) -> dict:
@@ -398,6 +516,15 @@ def main() -> None:
     # CPU-fallback artifact, not an empty record.
     import os
 
+    if len(sys.argv) > 1 and sys.argv[1] == "--pool-scaling-child":
+        # the 2-virtual-device scaling subprocess (bench_pool_scaling):
+        # platform/devices come from the parent's env, set before the
+        # module-level jax import of this fresh process
+        print(json.dumps(
+            _pool_scaling_child(int(sys.argv[2]), int(sys.argv[3]))
+        ))
+        return
+
     from madraft_tpu._platform import apply_platform, init_backend_with_retry
 
     # bench runs exist to leave artifacts — opt in to TUNNEL_STATUS.jsonl
@@ -432,6 +559,11 @@ def main() -> None:
     # horizons makes it first-order (PERF.md round 6); smokes keep a small
     # budget so the row stays cheap on CPU
     pool = bench_pool(max(64, n_clusters // 16), max(2400, 12 * n_ticks))
+    # sharded-pool 1-vs-2-device scaling A/B (ROADMAP item 1), in its own
+    # 2-virtual-device subprocess; smaller budget than the pool row — it
+    # pays two full pool runs
+    pscale = bench_pool_scaling(max(64, n_clusters // 16),
+                                max(1200, 6 * n_ticks))
     # coverage-guided vs uniform-random A/B (ROADMAP item 3): the
     # ground-truth reached-fraction comparison plus the planted-bug leg;
     # a smaller budget than the pool row — two extra pool runs per leg
@@ -474,6 +606,10 @@ def main() -> None:
                         "viol_per_chip_s_ratio"
                     ],
                     "pool": pool,
+                    "pool_scaling_efficiency": pscale.get(
+                        "scaling_efficiency"
+                    ),
+                    "pool_scaling": pscale,
                     "coverage_state_ratio": covr["ground_truth"][
                         "state_ratio"
                     ],

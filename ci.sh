@@ -158,6 +158,57 @@ print(f"coverage smoke: {summary['retired_violating']} violating, "
       f"(mutated {cov['refills_mutated']}, fresh {cov['refills_fresh']})")
 PY
 
+# sharded-pool smoke (ISSUE 7): the pod-scale lane-partitioned pool on the
+# 2-virtual-device CI config. The planted-bug leg must retire >= 1 violating
+# cluster and exit 1; the clean leg must retire everything at the horizon
+# and exit 0; the coverage leg proves the coverage+mesh gate is lifted
+# (per-shard seen-set, union-counted fingerprints). Reports at any device
+# count are the same multiset (tests/test_pool.py pins 1-vs-2 equality).
+MADTPU_PLATFORM=cpu JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=2" python - <<'PY'
+import contextlib, io, json
+from madraft_tpu.__main__ import main
+
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["pool", "--profile", "durability", "--bug", "ack_before_fsync",
+               "--clusters", "64", "--ticks", "300", "--chunk-ticks", "100",
+               "--budget-ticks", "600", "--seed", "1", "--devices", "2"])
+lines = [json.loads(x) for x in buf.getvalue().strip().splitlines()]
+summary = lines[-1]
+assert rc == 1, f"sharded pool bug leg exit {rc} != 1"
+assert summary["retired_violating"] >= 1, summary
+assert summary["devices"] == 2 and summary["id_scheme"] == "lane", summary
+rows = [r for r in lines[:-1] if r.get("violations")]
+assert rows and rows[0]["cluster_id"] in summary["violating_clusters"], rows
+
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["pool", "--profile", "durability", "--clusters", "64",
+               "--ticks", "300", "--chunk-ticks", "100",
+               "--budget-ticks", "300", "--seed", "12345", "--devices", "2"])
+clean = json.loads(buf.getvalue().strip().splitlines()[-1])
+assert rc == 0, f"sharded pool clean leg exit {rc} != 0"
+assert clean["retired_violating"] == 0 and clean["retired"] == 64, clean
+
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["pool", "--profile", "durability", "--bug", "ack_before_fsync",
+               "--clusters", "64", "--ticks", "300", "--chunk-ticks", "100",
+               "--budget-ticks", "600", "--seed", "1", "--coverage",
+               "--devices", "2"])
+lines = [json.loads(x) for x in buf.getvalue().strip().splitlines()]
+cov = lines[-1]["coverage"]
+assert rc == 1 and lines[-1]["retired_violating"] >= 1, lines[-1]
+assert cov["shards"] == 2 and cov["seen_fingerprints"] > 0, cov
+assert all("refill" in r and "knobs" in r for r in lines[:-1])
+print(f"sharded pool smoke: bug leg retired "
+      f"{summary['retired_violating']} violating on 2 shards, clean leg "
+      f"64/64 at horizon, coverage leg {cov['seen_fingerprints']} union "
+      f"fingerprints (gap {summary['dispatch_gap_s']}s, overlap "
+      f"{summary['host_overlap_s']}s)")
+PY
+
 echo "== [5/5] bench smoke (1024 clusters x 128 ticks)"
 # prefer the attached accelerator; fall back to CPU if it is absent or hung
 timeout 600 python bench.py 1024 128 \

@@ -236,11 +236,18 @@ def cmd_fuzz(args):
 def cmd_pool(args):
     """Continuous fuzzing pool (retire-and-refill): --clusters lanes stay
     resident on device; a lane retires when its cluster violated or reached
-    the --ticks horizon and is refilled with a fresh cluster under the next
+    the --ticks horizon and is refilled with a fresh cluster under a new
     global id — the (seed, cluster_id) replay contract survives arbitrarily
     many refills, so any streamed hit replays/explains exactly like a fuzz
     hit. Streams one JSONL line per retired cluster (with the running
-    violations/s), then a summary line; exit 1 iff a violation was found."""
+    violations/s), then a summary line; exit 1 iff a violation was found.
+
+    --devices N is the pod-scale path: lanes shard over the first N
+    attached devices under the lane-partitioned global-id scheme (lane l's
+    generation-g cluster owns id g*lanes + l; config.pool_lane/pool_shard
+    decode it), which keeps refill bookkeeping per-shard and makes the
+    retired-report multiset independent of the device count. --mesh is
+    shorthand for --devices <all attached>."""
     import jax
 
     from madraft_tpu.tpusim.engine import run_pool
@@ -255,6 +262,22 @@ def cmd_pool(args):
         print(f"pool: {msg}", file=sys.stderr)
         raise SystemExit(2)
 
+    if args.devices < 0:
+        # a negative count (e.g. a typo for a positive one) must not
+        # silently fall back to the single-device monotone pool
+        usage_error(f"--devices {args.devices} must be >= 1 (0 = unset)")
+    devices = args.devices if args.devices > 0 else None
+    if args.mesh and devices is None:
+        devices = len(jax.devices())
+    if devices is not None:
+        from madraft_tpu.tpusim.engine import _pool_mesh
+
+        try:
+            # the engine's validation (device count, the one shard-layout
+            # rule), surfaced as a clean usage error instead of a traceback
+            _pool_mesh(args.clusters, devices)
+        except ValueError as e:
+            usage_error(str(e))
     ccfg = None
     if not args.coverage and (args.coverage_random
                               or args.coverage_bits is not None):
@@ -270,12 +293,6 @@ def cmd_pool(args):
     if args.coverage:
         from madraft_tpu.tpusim.config import CoverageConfig
 
-        if args.mesh:
-            usage_error(
-                "--coverage is single-device for now (the seen-set bitmap "
-                "is one shared array; ROADMAP item 1 owns the sharded "
-                "pool) — drop --mesh or --coverage"
-            )
         bits = {} if args.coverage_bits is None else \
             {"bitmap_bits": args.coverage_bits}
         try:
@@ -290,7 +307,7 @@ def cmd_pool(args):
     summary = run_pool(
         cfg, args.seed, args.clusters, args.ticks,
         chunk_ticks=args.chunk_ticks, budget_ticks=budget_ticks,
-        budget_seconds=budget_seconds, mesh=_mesh(args),
+        budget_seconds=budget_seconds, devices=devices,
         on_retired=on_retired, coverage=ccfg,
     )
     dev = jax.devices()[0]
@@ -683,7 +700,17 @@ def main(argv=None) -> int:
     )
     common(sp, 4096)
     sp.add_argument("--mesh", action="store_true",
-                    help="shard the lane batch over ALL attached devices")
+                    help="shard the lane batch over ALL attached devices "
+                         "(shorthand for --devices <device count>)")
+    sp.add_argument("--devices", type=int, default=0,
+                    help="pod-scale sharded pool: shard the lanes over the "
+                         "FIRST N attached devices under the lane-"
+                         "partitioned global-id scheme (lane l's "
+                         "generation-g cluster owns id g*lanes + l), so "
+                         "refill bookkeeping stays per-shard and the "
+                         "retired-report multiset is identical at any "
+                         "device count; N must divide --clusters "
+                         "(0 = the single-device monotone-id pool)")
     sp.add_argument("--chunk-ticks", type=int, default=0,
                     help="ticks per compiled chunk between harvests (0 = "
                          "the horizon split into equal chunks of at most "

@@ -307,6 +307,58 @@ def violation_names(mask: int) -> list:
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 
 
+# ---------------------------------------------------------------------------
+# Pod-scale pool id scheme (ROADMAP item 1; programs live in engine.py).
+#
+# The sharded pool (`run_pool(devices=N)`, CLI `pool --devices`) partitions
+# the global-id space PER LANE: lane l's generation-g cluster owns global id
+# g * n_lanes + l (generation 0 = the initial batch, ids 0..n_lanes-1).
+# Lanes shard contiguously over devices, so shard s owns lanes
+# [s * n_lanes/n_shards, (s+1) * n_lanes/n_shards) and draws exactly the ids
+# congruent to those lanes mod n_lanes — refill bookkeeping is a per-lane
+# generation bump with no cross-shard synchronization, and the id set a
+# budgeted run draws is independent of the device count (the replay-contract
+# invariance engine._lane_reseed documents and tests/test_pool.py enforces).
+# These decoders are the shared vocabulary for reports, tests, and debugging
+# (e.g. "which shard harvested cluster 113?").
+# ---------------------------------------------------------------------------
+
+
+def pool_lane(cluster_id: int, n_lanes: int) -> int:
+    """Lane slot that ran ``cluster_id`` under the lane-partitioned scheme."""
+    return int(cluster_id) % n_lanes
+
+
+def pool_generation(cluster_id: int, n_lanes: int) -> int:
+    """Refill generation of ``cluster_id`` (0 = initial batch) under the
+    lane-partitioned scheme. (The single-device monotone scheme assigns ids
+    in batch-wide retirement order, so ``id // n_lanes`` is only a dense
+    cohort index there — not any lane's generation.)"""
+    return int(cluster_id) // n_lanes
+
+
+def pool_lanes_per_shard(n_lanes: int, n_shards: int) -> int:
+    """THE shard-layout rule (one copy): lanes split into ``n_shards``
+    contiguous equal slices, so shard ``s`` owns lanes ``[s * lps,
+    (s+1) * lps)`` with ``lps = n_lanes // n_shards``. Every consumer —
+    ``pool_shard`` here, ``coverage.lane_shards``, and the engine's mesh
+    validation — routes through this, so the layout cannot drift between
+    report decoding and actual device placement."""
+    if n_lanes % n_shards:
+        raise ValueError(
+            f"lanes ({n_lanes}) must divide evenly over shards ({n_shards})"
+        )
+    return n_lanes // n_shards
+
+
+def pool_shard(cluster_id: int, n_lanes: int, n_shards: int) -> int:
+    """Device shard that ran (and harvested) ``cluster_id`` in an
+    ``n_shards``-device pool."""
+    return pool_lane(cluster_id, n_lanes) // pool_lanes_per_shard(
+        n_lanes, n_shards
+    )
+
+
 def storm_profiles() -> dict:
     """The tuned fault-storm profiles the planted raft bugs need to
     manifest, with the fuzz scale each was validated at (the single source

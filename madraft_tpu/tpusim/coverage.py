@@ -45,7 +45,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from madraft_tpu.tpusim.config import CoverageConfig, Knobs
+from madraft_tpu.tpusim.config import (
+    CoverageConfig,
+    Knobs,
+    pool_lanes_per_shard,
+)
 from madraft_tpu.tpusim.state import ClusterState, abstract_node_tuple
 
 U32 = jnp.uint32
@@ -123,6 +127,19 @@ def bitmap_index(ccfg: CoverageConfig, n_nodes: int,
     if identity_mapped(n_nodes, ccfg):
         return code.astype(jnp.int32)
     return (_mix32(code) & U32(ccfg.bitmap_bits - 1)).astype(jnp.int32)
+
+
+def lane_shards(n_lanes: int, n_shards: int) -> jax.Array:
+    """i32 [n_lanes] lane -> shard map for the pod-scale pool's PER-SHARD
+    seen-set (ROADMAP 3a): the vectorized twin of ``config.pool_shard``
+    (both route through ``config.pool_lanes_per_shard`` — ONE copy of the
+    contiguous-slice layout rule). Each lane updates only row
+    ``lane_shards[l]`` of the ``[n_shards, bitmap_bits]`` bitmap — the
+    per-tick update never crosses a shard boundary; the engine's sharded
+    harvest OR-reduces the rows so summary coverage counts the exact union
+    (in identity mode)."""
+    lps = pool_lanes_per_shard(n_lanes, n_shards)
+    return jnp.arange(n_lanes, dtype=jnp.int32) // lps
 
 
 def refill_knobs(

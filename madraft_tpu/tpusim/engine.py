@@ -22,6 +22,7 @@ from madraft_tpu.tpusim.config import (
     CoverageConfig,
     Knobs,
     SimConfig,
+    pool_lanes_per_shard,
     violation_names,
 )
 from madraft_tpu.tpusim.state import ClusterState, init_cluster
@@ -289,11 +290,14 @@ def _retired_row(h, lane: int, wall: float, viol_total: int) -> dict:
 
 
 def _pool_summary(n_clusters: int, horizon: int, chunk_ticks: int,
-                  lane_ticks: int, retired_total: int, viol_total: int,
-                  viol_clusters: list, union: int, effective: int,
-                  wall: float, next_id) -> dict:
-    """The pool summary dict — shared by the plain and coverage pools (the
-    coverage pool adds its ``coverage`` sub-dict on top)."""
+                  lane_ticks: int, acct: "_PoolAccount", wall: float,
+                  tele: dict, id_fields: dict) -> dict:
+    """The pool summary dict — ONE builder for every pool path (monotone or
+    lane-partitioned ids, plain or coverage; the coverage pools add their
+    ``coverage`` sub-dict on top). ``tele`` carries the pipeline telemetry
+    (compile_s / dispatch_gap_s / host_overlap_s) and ``id_fields`` the
+    id-scheme-specific bookkeeping (next_cluster_id, or the lane scheme's
+    id_scheme / devices / id_watermark)."""
     dispatched = lane_ticks * n_clusters
     return {
         "lanes": n_clusters,
@@ -301,20 +305,215 @@ def _pool_summary(n_clusters: int, horizon: int, chunk_ticks: int,
         "chunk_ticks": chunk_ticks,
         "lane_ticks": lane_ticks,
         "ticks_dispatched": dispatched,
-        "retired": retired_total,
-        "retired_violating": viol_total,
-        "violating_clusters": viol_clusters[:16],
-        "violating_clusters_total": len(viol_clusters),
-        "violation_names": violation_names(union),
-        "effective_cluster_steps": int(effective),
+        "retired": acct.retired_total,
+        "retired_violating": acct.viol_total,
+        "violating_clusters": acct.viol_clusters[:16],
+        "violating_clusters_total": len(acct.viol_clusters),
+        "violation_names": violation_names(acct.union),
+        "effective_cluster_steps": int(acct.effective),
         "wall_s": round(wall, 3),
         "steps_per_sec": round(dispatched / wall, 1) if wall > 0 else None,
         "effective_steps_per_sec": (
-            round(effective / wall, 1) if wall > 0 else None
+            round(acct.effective / wall, 1) if wall > 0 else None
         ),
-        "violations_per_s": round(viol_total / wall, 3) if wall > 0 else None,
-        "next_cluster_id": int(next_id),
+        "violations_per_s": (
+            round(acct.viol_total / wall, 3) if wall > 0 else None
+        ),
+        **tele,
+        **id_fields,
     }
+
+
+class _PoolAccount:
+    """Host-side harvest accounting shared by every pool path: retirement
+    counters, the effective-steps convention (post-violation ticks inside
+    the retirement chunk are waste, not coverage), JSONL row emission —
+    and, when the harvest carries coverage columns (``new_fps``), the
+    discovery curve and refill-kind tallies. ``consume`` is called only
+    from _pipeline's single consumer thread (in harvest order) while the
+    NEXT chunk executes on device — the overlap — and ``finish`` only
+    after that thread joins, so no locking is needed."""
+
+    def __init__(self, on_retired, guided: bool = False):
+        self.on_retired = on_retired
+        self.guided = guided
+        self.retired_total = 0
+        self.viol_total = 0
+        self.effective = 0
+        self.union = 0
+        self.viol_clusters: list = []
+        self.last = None
+        # coverage extras (stay zero on plain harvests)
+        self.seen_prev = 0
+        self.new_fp_per_gen: list = []
+        self.refills_mutated = 0
+        self.refills_fresh = 0
+        self.lane_new_fps_total = 0
+
+    def consume(self, h, wall: float, children_ran: bool) -> None:
+        """Account one fetched harvest. ``children_ran`` is True iff a
+        following chunk was dispatched, i.e. this harvest's refilled
+        children actually ran a tick — the refills_* summary fields claim
+        to record how lanes were actually spent."""
+        self.last = h
+        cov = hasattr(h, "new_fps")
+        if cov:
+            seen_now = int(h.seen_bits)
+            self.new_fp_per_gen.append(seen_now - self.seen_prev)
+            self.seen_prev = seen_now
+        for lane in np.nonzero(h.retired)[0]:
+            mask = int(h.violations[lane])
+            fvt = int(h.first_violation_tick[lane])
+            ticks_run = int(h.ticks_run[lane])
+            self.retired_total += 1
+            # pre-violation ticks only: post-violation ticks inside the
+            # retirement chunk are waste, not coverage
+            self.effective += fvt if mask else ticks_run
+            if cov:
+                self.lane_new_fps_total += int(h.new_fps[lane])
+            if mask:
+                self.viol_total += 1
+                self.union |= mask
+                self.viol_clusters.append(int(h.ids[lane]))
+            if self.on_retired is not None:
+                row = _retired_row(h, lane, wall, self.viol_total)
+                if cov:
+                    row["new_fingerprints"] = int(h.new_fps[lane])
+                    row["refill"] = _cov.REFILL_NAMES[
+                        int(h.refill_kind[lane])
+                    ]
+                    row["knobs"] = {
+                        name: float(getattr(h.knobs, name)[lane])
+                        for name in _cov.MUTABLE_KNOBS
+                    }
+                self.on_retired(row)
+        if cov and children_ran and self.guided:
+            productive = h.retired & (h.new_fps > 0)
+            self.refills_mutated += int(productive.sum())
+            self.refills_fresh += int((h.retired & ~productive).sum())
+
+    def finish(self) -> None:
+        """In-flight lanes at shutdown are clean (violated => retired):
+        their ticks so far are honest pre-violation coverage."""
+        h = self.last
+        self.effective += int(h.ticks_run[~h.retired].sum())
+        if hasattr(h, "new_fps"):
+            self.lane_new_fps_total += int(h.new_fps[~h.retired].sum())
+
+
+def _pipeline(launch_chunk, launch_harvest, acct: _PoolAccount,
+              chunk_ticks: int, budget_ticks: Optional[int],
+              budget_seconds: Optional[float]) -> tuple:
+    """The pipelined chunk→harvest loop shared by every pool path.
+
+    ``launch_chunk()`` / ``launch_harvest()`` dispatch one compiled chunk
+    and one harvest+refill over the carry; the main loop runs them in the
+    strict PR-3 device order (chunk k, harvest k, fetch k — so the program
+    sequence, and with it every report, is bit-identical to the serialized
+    loop), but hands each FETCHED harvest to a dedicated consumer thread:
+    JSONL emission, refill bookkeeping, and coverage accounting for chunk
+    k then run WHILE chunk k+1 executes on device, instead of sitting on
+    the critical path between chunks.
+
+    Why a thread and not dispatch-ahead: measured on the CPU backend,
+    whether a donating jit dispatch returns asynchronously or runs the
+    whole execution inline inside the dispatch is BISTABLE — it depends on
+    the execution history, and both regimes are self-sustaining — so a
+    loop that relies on launching chunk k+1 before touching harvest k
+    silently degrades to full serialization in one of the two stable
+    regimes. The consumer thread overlaps in every regime and on every
+    backend: the main thread only performs device calls (which release
+    the GIL), the worker only consumes already-fetched numpy arrays and
+    never calls into JAX. One worker + a FIFO queue keeps consumption in
+    harvest order, so rows stream and accumulate exactly as before; the
+    bounded queue back-pressures a host-bound run instead of buffering
+    unboundedly.
+
+    Telemetry:
+    - ``device_wait_s``    main-thread wall inside device dispatch+fetch:
+                           the device-bound share of the run.
+    - ``dispatch_gap_s``   everything else on the main thread plus the
+                           end-of-run drain (waiting for the worker to
+                           finish outstanding host work): the wall that
+                           separates consecutive device dispatches.
+                           Healthy = milliseconds; it grows toward the
+                           host work only when emission out-runs a whole
+                           chunk's device wall.
+    - ``host_overlap_s``   harvest-processing wall that ran while the
+                           device loop was still dispatching — work the
+                           serialized loop paid on the critical path, now
+                           hidden under device execution.
+
+    Returns ``(lane_ticks, wall, dispatch_gap_s, device_wait_s,
+    host_overlap_s)``.
+    """
+    import queue as queue_mod
+    import threading
+
+    t0 = time.perf_counter()
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=8)
+    host_work = [0.0]
+    exc: list = []
+
+    def consumer():
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            h, wall_at_fetch, children_ran = item
+            t1 = time.perf_counter()
+            try:
+                acct.consume(h, wall_at_fetch, children_ran)
+            except BaseException as e:  # surface on the main thread
+                exc.append(e)
+                return
+            finally:
+                host_work[0] += time.perf_counter() - t1
+
+    worker = threading.Thread(target=consumer, name="pool-harvest-consumer")
+    worker.start()
+    lane_ticks = 0
+    device_s = 0.0
+    t_loop = t0
+    try:
+        while True:
+            t1 = time.perf_counter()
+            launch_chunk()
+            h_dev = launch_harvest()
+            # the ONLY device->host fetch of the loop: small per-slot arrays
+            h = jax.tree.map(np.asarray, h_dev)
+            t2 = time.perf_counter()
+            device_s += t2 - t1
+            lane_ticks += chunk_ticks
+            wall = t2 - t0
+            stop = (
+                (budget_ticks is not None and lane_ticks >= budget_ticks)
+                or (budget_seconds is not None and wall >= budget_seconds)
+            )
+            while not exc:  # a dead worker must not deadlock the put
+                try:
+                    q.put((h, wall, not stop), timeout=1.0)
+                    break
+                except queue_mod.Full:
+                    continue
+            if stop or exc:
+                break
+    finally:
+        while worker.is_alive():  # a full queue must not deadlock shutdown
+            try:
+                q.put(None, timeout=1.0)
+                break
+            except queue_mod.Full:
+                continue
+        t_loop = time.perf_counter()
+        worker.join()
+        if exc:
+            raise exc[0]
+    t_end = time.perf_counter()
+    drain = t_end - t_loop
+    gap = max(0.0, (t_loop - t0) - device_s) + drain
+    overlap = max(0.0, host_work[0] - drain)
+    return lane_ticks, t_end - t0, gap, device_s, overlap
 
 
 def default_chunk_ticks(horizon: int) -> int:
@@ -399,29 +598,99 @@ def _scatter_fresh(retired, fresh, states):
 
 
 @functools.lru_cache(maxsize=None)
-def _harvest_program(static_cfg: SimConfig, n_clusters: int,
-                     mesh: Optional[Mesh]):
+def _harvest_program(static_cfg: SimConfig, n_clusters: int):
     """Harvest + refill, one compiled call (states donated): snapshot the
     small per-slot report arrays, then scatter freshly init_cluster-ed
     states into retired lanes under new global ids next_id, next_id+1, ...
-    (see _retire_and_reseed)."""
-    constraint = _constraint(mesh)
+    (see _retire_and_reseed). Single-device by construction — the monotone
+    id rank is a batch-wide cumsum; the sharded pool uses
+    _lane_harvest_program instead."""
 
     def run(states, keys, ids, next_id, seed, kn, horizon):
         retired, new_ids, new_keys, n_ret = _retire_and_reseed(
             states, ids, next_id, seed, horizon
         )
-        harvest = PoolHarvest(
-            retired=retired,
-            ids=ids,
-            violations=states.violations,
-            first_violation_tick=states.first_violation_tick,
-            first_leader_tick=states.first_leader_tick,
-            committed=states.shadow_len,
-            msg_count=states.msg_count,
-            snap_installs=states.snap_install_count,
-            ticks_run=states.tick,
+        harvest = _pool_snapshot(states, retired, ids)
+        fresh = jax.vmap(
+            functools.partial(init_cluster, static_cfg), in_axes=(0, None)
+        )(new_keys, kn)
+        states_out = _scatter_fresh(retired, fresh, states)
+        return states_out, new_keys, new_ids, next_id + n_ret, harvest
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
+# --------------------------------------------------------------------------
+# Pod-scale sharding (ROADMAP item 1): the LANE-PARTITIONED global-id scheme.
+#
+# The monotone scheme above ranks retired lanes with a batch-wide cumsum —
+# a cross-lane (on a mesh: cross-SHARD) scan at every harvest. The sharded
+# pool partitions the id space per lane instead: lane l's generation-g
+# cluster owns global id  g * n_lanes + l  (generation 0 is the initial
+# batch, ids 0..n-1 — identical coverage of the id space to the monotone
+# scheme's first generation). Refill bookkeeping is then a per-lane
+# generation bump: purely elementwise, so a mesh-sharded harvest runs with
+# ZERO cross-shard communication on the hot path — shard s (a contiguous
+# lane slice) draws exactly the ids congruent to its lanes mod n_lanes.
+#
+# The payoff is a theorem the tests enforce: a cluster's whole lifetime is
+# a pure function of (seed, global_id, chunk cadence, horizon) — every lane
+# advances in lockstep by chunk_ticks, so a cluster born at any harvest
+# boundary sees the same chunk schedule — and the id SET a budgeted run
+# draws is a pure function of the budget (lane l always draws l, n+l,
+# 2n+l, ...). Hence the multiset of retired-cluster reports over a fixed
+# tick budget is IDENTICAL at any device count, and every report replays
+# through replay_cluster(seed, global_id) exactly like a fuzz hit.
+# config.pool_lane/pool_generation/pool_shard decode the scheme.
+# --------------------------------------------------------------------------
+
+
+def _lane_reseed(states, ids, gens, seed, horizon, n_clusters: int):
+    """The lane-partitioned analogue of _retire_and_reseed: same retirement
+    rule, per-lane generation counters instead of a batch-wide cumsum, and
+    the same one-rule key derivation — key = fold_in(PRNGKey(seed),
+    global_id) for EVERY lane."""
+    retired = (states.violations != 0) | (states.tick >= horizon)
+    gens_new = gens + retired.astype(jnp.int32)
+    lane = jnp.arange(n_clusters, dtype=jnp.int32)
+    new_ids = jnp.where(retired, gens_new * n_clusters + lane, ids)
+    base = jax.random.PRNGKey(seed)
+    new_keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(new_ids)
+    return retired, new_ids, new_keys, gens_new
+
+
+def _pool_snapshot(states, retired, ids) -> PoolHarvest:
+    """The per-slot report arrays every harvest fetches (PRE-refill) — one
+    builder for the monotone and lane-partitioned harvest programs."""
+    return PoolHarvest(
+        retired=retired,
+        ids=ids,
+        violations=states.violations,
+        first_violation_tick=states.first_violation_tick,
+        first_leader_tick=states.first_leader_tick,
+        committed=states.shadow_len,
+        msg_count=states.msg_count,
+        snap_installs=states.snap_install_count,
+        ticks_run=states.tick,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _lane_harvest_program(static_cfg: SimConfig, n_clusters: int,
+                          mesh: Optional[Mesh]):
+    """Harvest + refill under the lane-partitioned id scheme (states
+    donated): same report snapshot and scatter as _harvest_program, but the
+    refill bookkeeping is the per-lane generation bump of _lane_reseed —
+    no cross-shard collective reaches the compiled program. A SEPARATE
+    cached program: the monotone pool's HLO (and golden guard) is
+    untouched."""
+    constraint = _constraint(mesh)
+
+    def run(states, keys, ids, gens, seed, kn, horizon):
+        retired, new_ids, new_keys, gens_new = _lane_reseed(
+            states, ids, gens, seed, horizon, n_clusters
         )
+        harvest = _pool_snapshot(states, retired, ids)
         fresh = jax.vmap(
             functools.partial(init_cluster, static_cfg), in_axes=(0, None)
         )(new_keys, kn)
@@ -430,10 +699,66 @@ def _harvest_program(static_cfg: SimConfig, n_clusters: int,
                 fresh, jax.tree.map(lambda _: constraint, fresh)
             )
             new_keys = jax.lax.with_sharding_constraint(new_keys, constraint)
+            new_ids = jax.lax.with_sharding_constraint(new_ids, constraint)
+            gens_new = jax.lax.with_sharding_constraint(gens_new, constraint)
         states_out = _scatter_fresh(retired, fresh, states)
-        return states_out, new_keys, new_ids, next_id + n_ret, harvest
+        return states_out, new_keys, new_ids, gens_new, harvest
 
     return jax.jit(run, donate_argnums=(0,))
+
+
+def _pool_mesh(n_clusters: int, devices: int) -> Optional[Mesh]:
+    """Validate a pool ``devices`` request and build its mesh over the
+    first ``devices`` attached devices (None for 1 — same lane-partitioned
+    program, no sharding constraints; the reports are identical either
+    way, which is the device-count-invariance contract)."""
+    avail = len(jax.devices())
+    if devices < 1:
+        raise ValueError(f"pool devices must be >= 1, got {devices}")
+    if devices > avail:
+        raise ValueError(
+            f"pool devices={devices} exceeds the {avail} attached "
+            f"device(s)"
+        )
+    pool_lanes_per_shard(n_clusters, devices)  # the one layout rule
+    if devices == 1:
+        return None
+    return Mesh(np.array(jax.devices()[:devices]), (CLUSTER_AXIS,))
+
+
+def _shard_put(tree, mesh: Optional[Mesh]):
+    """Place every leaf of ``tree`` sharded over the mesh's first axis
+    (leading-axis split); identity without a mesh."""
+    if mesh is None:
+        return tree
+    return jax.device_put(
+        tree, NamedSharding(mesh, P(mesh.axis_names[0]))
+    )
+
+
+def _summary_fields(compile_s: float, gap: float, wait: float,
+                    overlap: float, devices: Optional[int], book,
+                    n_clusters: int) -> tuple:
+    """The pipeline-telemetry and id-scheme summary fields shared by the
+    plain and coverage pool bodies — one copy, so the two summaries cannot
+    drift. ``book`` is the final id bookkeeping carry: per-lane generation
+    counters under the lane scheme, the monotone next-id scalar otherwise.
+    The three pipeline timers are defined at ``_pipeline``."""
+    tele = {
+        "compile_s": round(compile_s, 4),
+        "dispatch_gap_s": round(gap, 4),
+        "device_wait_s": round(wait, 4),
+        "host_overlap_s": round(overlap, 4),
+    }
+    if devices is not None:
+        # id-space watermark: every id ever drawn is < (max generation + 1)
+        # * lanes; the scheme itself is documented at _lane_reseed
+        watermark = (int(np.asarray(book).max()) + 1) * n_clusters
+        id_fields = {"id_scheme": "lane", "devices": devices,
+                     "id_watermark": watermark}
+    else:
+        id_fields = {"next_cluster_id": int(book)}
+    return tele, id_fields
 
 
 def make_chunked_fuzz_fn(
@@ -476,7 +801,7 @@ def run_pool(
     chunk_ticks: int = 0,
     budget_ticks: Optional[int] = None,
     budget_seconds: Optional[float] = None,
-    mesh: Optional[Mesh] = None,
+    devices: Optional[int] = None,
     on_retired=None,
     coverage: Optional[CoverageConfig] = None,
 ) -> dict:
@@ -484,21 +809,39 @@ def run_pool(
     is spent. ``n_clusters`` lanes stay resident on device; a lane retires
     when its cluster violated or reached ``horizon`` ticks (detected at
     chunk boundaries, so a lane's age is always a multiple of
-    ``chunk_ticks``), and is refilled with a fresh cluster under the next
+    ``chunk_ticks``), and is refilled with a fresh cluster under a new
     global id. ``on_retired`` (if given) is called with one report dict per
-    retired cluster, in retirement order — the streaming JSONL source.
+    retired cluster, in retirement order — the streaming JSONL source. It
+    runs on the pool's harvest-consumer thread (``_pipeline``): harvest
+    processing and emission overlap the next chunk's device execution
+    instead of serializing with it, so the callback must not call back
+    into JAX; the summary's ``dispatch_gap_s`` / ``device_wait_s`` /
+    ``host_overlap_s`` report the measured pipeline, and ``compile_s``
+    the (untimed-window) program warm-up.
 
     Budgets: ``budget_ticks`` stops once every lane has dispatched that many
     ticks (rounded up to whole chunks); ``budget_seconds`` stops at the
     first harvest past the wall-clock budget; neither given = one horizon.
     Returns a summary dict (counts, effective pre-violation steps, rates).
 
+    ``devices`` (int >= 1) is the pod-scale path (ROADMAP item 1): lanes
+    shard contiguously over the first ``devices`` attached devices and
+    global ids follow the LANE-PARTITIONED scheme (lane l's generation-g
+    cluster owns id ``g * n_clusters + l`` — see the scheme comment above
+    _lane_reseed), so refill bookkeeping is per-shard with no cross-shard
+    synchronization, and the multiset of retired reports over a fixed tick
+    budget is bit-identical at ANY device count (test-enforced).
+    ``devices=1`` runs the same scheme unsharded. ``None`` (the default)
+    is the historic single-device monotone-id pool — byte-identical
+    programs and reports (golden guard).
+
     ``coverage`` (a ``config.CoverageConfig``) turns the pool into the
     coverage-guided corpus scheduler (ROADMAP item 3): every tick each
     lane's abstract-state fingerprint (coverage.py) updates a
     device-resident seen-set, and the refill step is BIASED — see
-    ``_run_pool_coverage``. ``None`` (the default) is the historic pool,
-    byte-identical programs and reports.
+    ``_run_pool_coverage``. With ``devices`` the seen-set is PER-SHARD
+    (one bitmap row per shard, OR-reduced at harvest/summary time), so
+    coverage composes with the mesh.
     """
     if horizon < 1:
         raise ValueError(f"pool horizon must be >= 1 tick, got {horizon}")
@@ -506,74 +849,70 @@ def run_pool(
         chunk_ticks = default_chunk_ticks(horizon)
     if budget_ticks is None and budget_seconds is None:
         budget_ticks = horizon
+    mesh = None if devices is None else _pool_mesh(n_clusters, devices)
     if coverage is not None:
         return _run_pool_coverage(
             cfg, seed, n_clusters, horizon, coverage,
             chunk_ticks=chunk_ticks, budget_ticks=budget_ticks,
-            budget_seconds=budget_seconds, mesh=mesh, on_retired=on_retired,
+            budget_seconds=budget_seconds, mesh=mesh, devices=devices,
+            on_retired=on_retired,
         )
     static = cfg.static_key()
     kn = cfg.knobs()
+    lane_ids = devices is not None
     init = _pool_init_program(static, n_clusters, mesh)
     chunk = _chunk_program(static, n_clusters)
-    harv = _harvest_program(static, n_clusters, mesh)
+    harv = (_lane_harvest_program(static, n_clusters, mesh) if lane_ids
+            else _harvest_program(static, n_clusters))
     seed_u = jnp.asarray(seed, jnp.uint32)
-    next_id = jnp.asarray(n_clusters, jnp.int32)
     hz = jnp.asarray(horizon, jnp.int32)
     ct = jnp.asarray(chunk_ticks, jnp.int32)
+
+    def book0():
+        # the id-scheme bookkeeping carried through the harvest: per-lane
+        # generation counters (lane scheme) or the monotone next-id scalar
+        if lane_ids:
+            return _shard_put(jnp.zeros((n_clusters,), jnp.int32), mesh)
+        return jnp.asarray(n_clusters, jnp.int32)
+
+    def steps(c, ticks):
+        """The _pipeline launch pair bound to a carry list."""
+
+        def launch_chunk():
+            c[0] = chunk(c[0], c[1], kn, ticks)
+
+        def launch_harvest():
+            out = harv(c[0], c[1], c[2], c[3], seed_u, kn, hz)
+            c[:] = out[:4]
+            return out[4]
+
+        return launch_chunk, launch_harvest
+
     # Warm all three programs OUTSIDE the timed window (a 1-tick chunk
     # compiles the same executable — the tick count is a runtime bound), so
     # a cold run's steps_per_sec/violations_per_s never silently include
     # compile time (run_telemetry's measurement-honesty convention). Warm
     # cost: n_clusters ticks + one harvest — noise against any real budget.
+    t_warm = time.perf_counter()
     ws, wk, wi = init(seed_u, kn, jnp.asarray(0, jnp.int32))
-    ws = chunk(ws, wk, kn, jnp.asarray(1, jnp.int32))
-    jax.block_until_ready(
-        harv(ws, wk, wi, next_id, seed_u, kn, hz)[4].retired
-    )
+    wc, wh = steps([ws, wk, wi, book0()], jnp.asarray(1, jnp.int32))
+    wc()
+    jax.block_until_ready(wh().retired)
+    compile_s = time.perf_counter() - t_warm
     states, keys, ids = init(seed_u, kn, jnp.asarray(0, jnp.int32))
-    t0 = time.perf_counter()
-    lane_ticks = 0
-    retired_total = 0
-    viol_total = 0
-    effective = 0
-    union = 0
-    viol_clusters: list = []
-    wall = 0.0
-    h = None
-    while True:
-        states = chunk(states, keys, kn, ct)
-        lane_ticks += chunk_ticks
-        states, keys, ids, next_id, h_dev = harv(
-            states, keys, ids, next_id, seed_u, kn, hz
-        )
-        # the ONLY device->host fetch of the loop: small per-slot arrays
-        h = jax.tree.map(np.asarray, h_dev)
-        wall = time.perf_counter() - t0
-        for lane in np.nonzero(h.retired)[0]:
-            mask = int(h.violations[lane])
-            fvt = int(h.first_violation_tick[lane])
-            ticks_run = int(h.ticks_run[lane])
-            retired_total += 1
-            # pre-violation ticks only: post-violation ticks inside the
-            # retirement chunk are waste, not coverage
-            effective += fvt if mask else ticks_run
-            if mask:
-                viol_total += 1
-                union |= mask
-                viol_clusters.append(int(h.ids[lane]))
-            if on_retired is not None:
-                on_retired(_retired_row(h, lane, wall, viol_total))
-        if budget_ticks is not None and lane_ticks >= budget_ticks:
-            break
-        if budget_seconds is not None and wall >= budget_seconds:
-            break
-    # in-flight lanes at shutdown are clean (violated => retired): their
-    # ticks so far are honest pre-violation coverage
-    effective += int(h.ticks_run[~h.retired].sum())
+    carry = [states, keys, ids, book0()]
+    launch_chunk, launch_harvest = steps(carry, ct)
+    acct = _PoolAccount(on_retired)
+    lane_ticks, wall, gap, wait, overlap = _pipeline(
+        launch_chunk, launch_harvest, acct, chunk_ticks, budget_ticks,
+        budget_seconds,
+    )
+    acct.finish()
+    tele, id_fields = _summary_fields(
+        compile_s, gap, wait, overlap, devices, carry[3], n_clusters
+    )
     return _pool_summary(n_clusters, horizon, chunk_ticks, lane_ticks,
-                         retired_total, viol_total, viol_clusters, union,
-                         effective, wall, next_id)
+                         acct, wall, tele, id_fields)
 
 
 # --------------------------------------------------------------------------
@@ -699,6 +1038,94 @@ def _cov_harvest_program(static_cfg: SimConfig, n_clusters: int,
     return jax.jit(run, donate_argnums=(0,))
 
 
+@functools.lru_cache(maxsize=None)
+def _cov_chunk_sharded_program(static_cfg: SimConfig, n_clusters: int,
+                               ccfg: CoverageConfig, n_shards: int):
+    """_cov_chunk_program with a PER-SHARD seen-set (ROADMAP 3a): the
+    bitmap is ``[n_shards, bitmap_bits]`` — one row per shard, sharded over
+    the mesh axis with the lanes — and each lane reads/writes ONLY its own
+    shard's row (lane -> shard is the contiguous-slice map
+    ``coverage.lane_shards``), so the per-tick seen-set update stays
+    shard-local: no cross-shard traffic joins the hot loop. Novelty (the
+    refill-bias credit) is therefore per-shard novelty — two shards may
+    each credit the same code once; the harvest OR-reduces the rows so the
+    summary's ``seen_fingerprints`` still counts the exact union. A
+    SEPARATE cached program: the single-device coverage pool's HLO is
+    untouched."""
+    shard_ix = _cov.lane_shards(n_clusters, n_shards)
+
+    def run(states, keys, kn_lanes, bitmap, new_fps, n_ticks):
+        def body(_, carry):
+            st, bm, nf = carry
+            st = jax.vmap(
+                functools.partial(step_cluster, static_cfg),
+                in_axes=(0, 0, 0),
+            )(st, keys, kn_lanes)
+            code = jax.vmap(functools.partial(_cov.abstract_code, ccfg))(st)
+            idx = _cov.bitmap_index(ccfg, static_cfg.n_nodes, code)
+            ok = st.violations == 0
+            nf = nf + (ok & ~bm[shard_ix, idx]).astype(jnp.int32)
+            bm = bm.at[shard_ix, idx].max(ok)
+            return st, bm, nf
+
+        return jax.lax.fori_loop(
+            0, n_ticks, body, (states, bitmap, new_fps)
+        )
+
+    return jax.jit(run, donate_argnums=(0, 3, 4))
+
+
+@functools.lru_cache(maxsize=None)
+def _cov_harvest_sharded_program(static_cfg: SimConfig, n_clusters: int,
+                                 ccfg: CoverageConfig,
+                                 mesh: Optional[Mesh]):
+    """_cov_harvest_program under the lane-partitioned id scheme: per-lane
+    generation bookkeeping (_lane_reseed — no cross-shard scan), the same
+    biased-refill policy (knob draws are a pure function of (seed, new
+    global id), so mutated lanes replay identically at any device count),
+    and ``seen_bits`` = popcount of the OR over the shard bitmaps — the
+    one cross-shard reduction, paid at harvest time on the small bitmap,
+    never on the per-tick path."""
+    constraint = _constraint(mesh)
+
+    def run(states, keys, ids, gens, kn_lanes, kinds, new_fps, bitmap,
+            seed, base_kn, horizon):
+        retired, new_ids, new_keys, gens_new = _lane_reseed(
+            states, ids, gens, seed, horizon, n_clusters
+        )
+        harvest = CovHarvest(
+            **_pool_snapshot(states, retired, ids)._asdict(),
+            new_fps=new_fps,
+            refill_kind=kinds,
+            seen_bits=jnp.sum(jnp.any(bitmap, axis=0), dtype=jnp.int32),
+            knobs=kn_lanes,
+        )
+        if ccfg.guided:
+            productive = retired & (new_fps > 0)
+            kn_new, drawn = _cov.refill_knobs(
+                ccfg, kn_lanes, base_kn, retired, productive, new_ids, seed
+            )
+            kinds_new = jnp.where(retired, drawn, kinds)
+        else:
+            kn_new, kinds_new = kn_lanes, kinds  # base rows forever
+        fresh = jax.vmap(
+            functools.partial(init_cluster, static_cfg), in_axes=(0, 0)
+        )(new_keys, kn_new)
+        if constraint is not None:
+            fresh = jax.lax.with_sharding_constraint(
+                fresh, jax.tree.map(lambda _: constraint, fresh)
+            )
+            new_keys = jax.lax.with_sharding_constraint(new_keys, constraint)
+            new_ids = jax.lax.with_sharding_constraint(new_ids, constraint)
+            gens_new = jax.lax.with_sharding_constraint(gens_new, constraint)
+        states_out = _scatter_fresh(retired, fresh, states)
+        new_fps_out = jnp.where(retired, 0, new_fps)
+        return (states_out, new_keys, new_ids, gens_new, kn_new, kinds_new,
+                new_fps_out, harvest)
+
+    return jax.jit(run, donate_argnums=(0,))
+
+
 def _run_pool_coverage(
     cfg: SimConfig,
     seed: int,
@@ -710,6 +1137,7 @@ def _run_pool_coverage(
     budget_ticks: Optional[int],
     budget_seconds: Optional[float],
     mesh: Optional[Mesh],
+    devices: Optional[int],
     on_retired,
 ) -> dict:
     """run_pool's coverage-guided body (see run_pool for the contract).
@@ -721,121 +1149,113 @@ def _run_pool_coverage(
     feed them to ``replay_cluster(..., knobs=row["knobs"])`` for bit-exact
     replay); the summary gains a ``coverage`` dict with the seen-set totals
     and the per-generation discovery curve.
+
+    With ``devices`` the seen-set is PER-SHARD (ROADMAP 3a; see
+    _cov_chunk_sharded_program) and ids follow the lane-partitioned
+    scheme. Per-shard novelty makes the GUIDED refill topology-dependent
+    (a lane's bias credit is novelty against its own shard's bitmap), so
+    coverage runs are exactly reproducible per device count but — unlike
+    the plain sharded pool — not invariant across counts; every retired
+    row still replays bit-exactly from its recorded knob row.
     """
-    if mesh is not None:
-        raise ValueError(
-            "the coverage pool is single-device for now (the seen-set "
-            "bitmap is one shared array; ROADMAP item 1 owns the sharded "
-            "pool) — drop mesh= or coverage="
-        )
+    sharded = devices is not None
     static = cfg.static_key()
     base_kn = cfg.knobs()
-    init = _pool_init_program(static, n_clusters, None)
+    init = _pool_init_program(static, n_clusters, mesh)
     # the chunk only reads the fingerprint fields — cache it on those, so
     # the A/B's guided/random legs share one compiled chunk executable
-    chunk = _cov_chunk_program(static, n_clusters, ccfg.fingerprint_key())
-    harv = _cov_harvest_program(static, n_clusters, ccfg)
+    if sharded:
+        chunk = _cov_chunk_sharded_program(
+            static, n_clusters, ccfg.fingerprint_key(), devices
+        )
+        harv = _cov_harvest_sharded_program(static, n_clusters, ccfg, mesh)
+    else:
+        chunk = _cov_chunk_program(static, n_clusters, ccfg.fingerprint_key())
+        harv = _cov_harvest_program(static, n_clusters, ccfg)
     seed_u = jnp.asarray(seed, jnp.uint32)
     hz = jnp.asarray(horizon, jnp.int32)
     ct = jnp.asarray(chunk_ticks, jnp.int32)
 
     def fresh_carry():
         states, keys, ids = init(seed_u, base_kn, jnp.asarray(0, jnp.int32))
-        kn_lanes = base_kn.broadcast(n_clusters)
-        kinds = jnp.full((n_clusters,), _cov.REFILL_SEED, jnp.int32)
-        new_fps = jnp.zeros((n_clusters,), jnp.int32)
-        bitmap = jnp.zeros((ccfg.bitmap_bits,), jnp.bool_)
-        return states, keys, ids, kn_lanes, kinds, new_fps, bitmap
+        kn_lanes = _shard_put(base_kn.broadcast(n_clusters), mesh)
+        kinds = _shard_put(
+            jnp.full((n_clusters,), _cov.REFILL_SEED, jnp.int32), mesh
+        )
+        new_fps = _shard_put(jnp.zeros((n_clusters,), jnp.int32), mesh)
+        if sharded:
+            # one seen-set row per shard, sharded over the mesh axis with
+            # the lanes (a [1, bits] row for devices=1)
+            bitmap = _shard_put(
+                jnp.zeros((devices, ccfg.bitmap_bits), jnp.bool_), mesh
+            )
+            book = _shard_put(jnp.zeros((n_clusters,), jnp.int32), mesh)
+        else:
+            bitmap = jnp.zeros((ccfg.bitmap_bits,), jnp.bool_)
+            book = jnp.asarray(n_clusters, jnp.int32)  # monotone next_id
+        return [states, keys, ids, book, kn_lanes, kinds, new_fps, bitmap]
+
+    def steps(c, ticks):
+        """The _pipeline launch pair bound to a carry list (shared by the
+        warm block and the timed loop)."""
+
+        def launch_chunk():
+            st, bm, nf = chunk(c[0], c[1], c[4], c[7], c[6], ticks)
+            c[0], c[7], c[6] = st, bm, nf
+
+        def launch_harvest():
+            if sharded:
+                (c[0], c[1], c[2], c[3], c[4], c[5], c[6], h_dev) = harv(
+                    c[0], c[1], c[2], c[3], c[4], c[5], c[6], c[7],
+                    seed_u, base_kn, hz
+                )
+            else:
+                (c[0], c[1], c[2], c[4], c[5], c[6], c[3], h_dev) = harv(
+                    c[0], c[1], c[2], c[4], c[5], c[6], c[7], c[3],
+                    seed_u, base_kn, hz
+                )
+            return h_dev
+
+        return launch_chunk, launch_harvest
 
     # warm all programs outside the timed window (run_pool convention; the
     # tick count is a runtime bound so 1 tick compiles the real executables)
-    ws, wk, wi, wkn, wkd, wnf, wbm = fresh_carry()
-    ws, wbm, wnf = chunk(ws, wk, wkn, wbm, wnf, jnp.asarray(1, jnp.int32))
-    next_id = jnp.asarray(n_clusters, jnp.int32)
-    jax.block_until_ready(
-        harv(ws, wk, wi, wkn, wkd, wnf, wbm, next_id, seed_u, base_kn,
-             hz)[7].retired
+    t_warm = time.perf_counter()
+    wc, wh = steps(fresh_carry(), jnp.asarray(1, jnp.int32))
+    wc()
+    jax.block_until_ready(wh().retired)
+    compile_s = time.perf_counter() - t_warm
+    carry = fresh_carry()
+    launch_chunk, launch_harvest = steps(carry, ct)
+    acct = _PoolAccount(on_retired, guided=ccfg.guided)
+    lane_ticks, wall, gap, wait, overlap = _pipeline(
+        launch_chunk, launch_harvest, acct, chunk_ticks, budget_ticks,
+        budget_seconds,
     )
-    states, keys, ids, kn_lanes, kinds, new_fps, bitmap = fresh_carry()
-    next_id = jnp.asarray(n_clusters, jnp.int32)
-    t0 = time.perf_counter()
-    lane_ticks = 0
-    retired_total = 0
-    viol_total = 0
-    effective = 0
-    union = 0
-    viol_clusters: list = []
-    wall = 0.0
-    h = None
-    seen_prev = 0
-    new_fp_per_gen: list = []
-    refills_mutated = 0
-    refills_fresh = 0
-    lane_new_fps_total = 0
-    while True:
-        states, bitmap, new_fps = chunk(
-            states, keys, kn_lanes, bitmap, new_fps, ct
-        )
-        lane_ticks += chunk_ticks
-        (states, keys, ids, kn_lanes, kinds, new_fps, next_id,
-         h_dev) = harv(states, keys, ids, kn_lanes, kinds, new_fps,
-                       bitmap, next_id, seed_u, base_kn, hz)
-        h = jax.tree.map(np.asarray, h_dev)
-        wall = time.perf_counter() - t0
-        seen_now = int(h.seen_bits)
-        new_fp_per_gen.append(seen_now - seen_prev)
-        seen_prev = seen_now
-        for lane in np.nonzero(h.retired)[0]:
-            mask = int(h.violations[lane])
-            fvt = int(h.first_violation_tick[lane])
-            ticks_run = int(h.ticks_run[lane])
-            retired_total += 1
-            effective += fvt if mask else ticks_run
-            lane_new_fps_total += int(h.new_fps[lane])
-            if mask:
-                viol_total += 1
-                union |= mask
-                viol_clusters.append(int(h.ids[lane]))
-            if on_retired is not None:
-                row = _retired_row(h, lane, wall, viol_total)
-                row["new_fingerprints"] = int(h.new_fps[lane])
-                row["refill"] = _cov.REFILL_NAMES[int(h.refill_kind[lane])]
-                row["knobs"] = {
-                    name: float(getattr(h.knobs, name)[lane])
-                    for name in _cov.MUTABLE_KNOBS
-                }
-                on_retired(row)
-        if budget_ticks is not None and lane_ticks >= budget_ticks:
-            break
-        if budget_seconds is not None and wall >= budget_seconds:
-            break
-        if ccfg.guided:
-            # counted only when the loop CONTINUES: the final harvest's
-            # refilled children never run a tick, and the summary's
-            # refills_* claim to record how lanes were actually spent
-            productive = h.retired & (h.new_fps > 0)
-            refills_mutated += int(productive.sum())
-            refills_fresh += int((h.retired & ~productive).sum())
-    effective += int(h.ticks_run[~h.retired].sum())
-    lane_new_fps_total += int(h.new_fps[~h.retired].sum())
+    acct.finish()
+    tele, id_fields = _summary_fields(
+        compile_s, gap, wait, overlap, devices, carry[3], n_clusters
+    )
     summary = _pool_summary(n_clusters, horizon, chunk_ticks, lane_ticks,
-                            retired_total, viol_total, viol_clusters, union,
-                            effective, wall, next_id)
+                            acct, wall, tele, id_fields)
     summary["coverage"] = {
         "bitmap_bits": ccfg.bitmap_bits,
         "identity": _cov.identity_mapped(cfg.n_nodes, ccfg),
         "guided": ccfg.guided,
-        "seen_fingerprints": seen_prev,
+        # with shards > 1 this is the popcount of the OR over the per-shard
+        # bitmaps — the exact union in identity mode
+        "seen_fingerprints": acct.seen_prev,
         "new_fingerprints_per_s": (
-            round(seen_prev / wall, 2) if wall > 0 else None
+            round(acct.seen_prev / wall, 2) if wall > 0 else None
         ),
-        "lane_new_fps_total": lane_new_fps_total,
-        "generations": len(new_fp_per_gen),
+        "lane_new_fps_total": acct.lane_new_fps_total,
+        "generations": len(acct.new_fp_per_gen),
         # truncated like violating_clusters[:16]; "generations" carries the
         # full count so a consumer can detect the cut
-        "new_fp_per_gen": new_fp_per_gen[:64],
-        "refills_mutated": refills_mutated,
-        "refills_fresh": refills_fresh,
+        "new_fp_per_gen": acct.new_fp_per_gen[:64],
+        "refills_mutated": acct.refills_mutated,
+        "refills_fresh": acct.refills_fresh,
+        **({"shards": devices} if sharded else {}),
     }
     return summary
 

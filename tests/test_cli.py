@@ -163,6 +163,46 @@ def test_cli_pool_streams_and_exit_codes():
     assert summary["retired"] == 16
 
 
+def test_cli_pool_devices_flag():
+    # the pod-scale pool from the front door: --devices shards the lanes
+    # under the lane-partitioned id scheme; --mesh is shorthand for all
+    # attached devices; a non-dividing lane count is a usage error (exit 2,
+    # distinct from the violation exit 1); a streamed hit replays exactly
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["pool", "--clusters", "16", "--ticks", "64",
+                   "--chunk-ticks", "32", "--budget-ticks", "128",
+                   "--storm", "--majority-override", "2", "--seed", "7",
+                   "--devices", "2"])
+    lines = [json.loads(x) for x in buf.getvalue().strip().splitlines()]
+    rows, summary = lines[:-1], lines[-1]
+    assert rc == 1 and summary["retired_violating"] > 0, summary
+    assert summary["devices"] == 2 and summary["id_scheme"] == "lane"
+    r = next(r for r in rows if r["violations"])
+    rc2, out = run(["replay", "--cluster", str(r["cluster_id"]),
+                    "--ticks", str(r["ticks_run"]), "--storm",
+                    "--majority-override", "2", "--seed", "7"])
+    assert rc2 == 1 and out["violations"] == r["violations"], (r, out)
+
+    if 16 % len(jax.devices()) == 0:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(["pool", "--clusters", "16", "--ticks", "64",
+                       "--budget-ticks", "64", "--storm", "--seed", "3",
+                       "--mesh"])
+        summary = json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert rc == 0 and summary["devices"] == len(jax.devices()), summary
+
+    with pytest.raises(SystemExit) as ei:
+        main(["pool", "--clusters", "15", "--ticks", "64",
+              "--devices", "2"])
+    assert ei.value.code == 2
+
+
 def test_cli_sweep_small_grid_uniform_dispatch():
     # a small grid rides the fast uniform-knob layout (per-cell programs)
     # and says so; cell accounting is unchanged

@@ -2,7 +2,10 @@
 dispatch: the first pool generation is bit-identical to straight fuzz, every
 pool hit replays bit-exactly via (seed, global_cluster_id) across refill
 generations, the chunk carry is donated, pool hits explain like fuzz hits,
-and a small-grid sweep's uniform dispatch matches the per-cluster layout."""
+the sharded (lane-partitioned) pool's report multiset is device-count
+invariant with shard-blind replay, the harvest-pipeline telemetry rides in
+every summary, and a small-grid sweep's uniform dispatch matches the
+per-cluster layout."""
 
 import jax.numpy as jnp
 import numpy as np
@@ -134,29 +137,107 @@ def test_pool_chunk_carry_is_donated():
         np.asarray(states.tick)
 
 
-def test_pool_mesh_matches_unsharded():
-    # --mesh shards the lane batch over all attached devices; retirement,
-    # refill ids and every report field must be identical to the unsharded
-    # pool (wall-clock fields excluded)
+def _strip(rows):
+    return [
+        {k: v for k, v in r.items()
+         if k not in ("wall_s", "violations_per_s")}
+        for r in rows
+    ]
+
+
+def test_pool_sharded_multiset_matches_single_device():
+    # the pod-scale replay contract (ISSUE 7): under the lane-partitioned
+    # global-id scheme (lane l's generation-g cluster owns id g*lanes + l),
+    # a cluster's lifetime is a pure function of (seed, global_id, chunk
+    # cadence, horizon) and the id set a tick budget draws is device-count
+    # independent — so the 2-device pool must produce the SAME MULTISET of
+    # retired-cluster reports as the 1-device run (emission order differs:
+    # harvests interleave lanes, not id order)
     import jax
 
     if len(jax.devices()) < 2:
         pytest.skip("needs the virtual multi-device mesh")
-    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("clusters",))
+    rows_1, rows_2 = [], []
+    s1 = run_pool(VIOL, 7, 16, 64, chunk_ticks=32, budget_ticks=320,
+                  devices=1, on_retired=rows_1.append)
+    s2 = run_pool(VIOL, 7, 16, 64, chunk_ticks=32, budget_ticks=320,
+                  devices=2, on_retired=rows_2.append)
+    key = lambda r: r["cluster_id"]  # noqa: E731
+    assert sorted(_strip(rows_2), key=key) == sorted(_strip(rows_1), key=key)
+    assert s2["devices"] == 2 and s2["id_scheme"] == "lane"
+    assert s1["retired"] == s2["retired"]
+    assert s1["retired_violating"] == s2["retired_violating"]
+    assert sorted(s1["violating_clusters"]) == sorted(s2["violating_clusters"])
+    # lane-partitioned ids: unique, refilled beyond generation 0, and every
+    # id decodes to its lane (id mod lanes) under the documented scheme
+    ids = [r["cluster_id"] for r in rows_2]
+    assert len(ids) == len(set(ids)), "a global cluster id was reused"
+    assert max(ids) >= 16, "no refill generation retired"
+    assert all(i < s2["id_watermark"] for i in ids)
+    from madraft_tpu.tpusim.config import pool_generation, pool_lane
 
-    def strip(rows):
-        return [
-            {k: v for k, v in r.items()
-             if k not in ("wall_s", "violations_per_s")}
-            for r in rows
-        ]
+    for r in rows_2:
+        i = r["cluster_id"]
+        assert pool_generation(i, 16) * 16 + pool_lane(i, 16) == i
 
-    rows_u, rows_m = [], []
-    run_pool(VIOL, 7, 16, 64, chunk_ticks=32, budget_ticks=128,
-             on_retired=rows_u.append)
-    run_pool(VIOL, 7, 16, 64, chunk_ticks=32, budget_ticks=128,
-             mesh=mesh, on_retired=rows_m.append)
-    assert strip(rows_m) == strip(rows_u)
+
+def test_pool_sharded_hit_replays_on_single_device():
+    # a violating hit harvested on shard 1 (lanes 8..15 of the 2-device
+    # run) replays bit-exactly through the ordinary single-device
+    # replay_cluster — the (seed, global_id) contract is shard-blind
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the virtual multi-device mesh")
+    from madraft_tpu.tpusim.config import pool_shard
+
+    rows = []
+    run_pool(VIOL, 7, 16, 64, chunk_ticks=32, budget_ticks=320,
+             devices=2, on_retired=rows.append)
+    viol = [r for r in rows if r["violations"]
+            and pool_shard(r["cluster_id"], 16, 2) == 1]
+    assert viol, "need a violating hit harvested on shard 1"
+    for r in viol[:4]:
+        st = replay_cluster(VIOL, 7, r["cluster_id"], r["ticks_run"])
+        assert int(st.violations) == r["violations"]
+        assert int(st.first_violation_tick) == r["first_violation_tick"]
+        assert int(st.shadow_len) == r["committed"]
+        assert int(st.msg_count) == r["msg_count"]
+
+
+def test_pool_summary_pipeline_telemetry():
+    # the pipeline telemetry (ISSUE 7) rides in every pool summary:
+    # warm-up compile wall, the inter-dispatch gap, the device-bound wall,
+    # and the host harvest/emit wall that overlapped device execution
+    _, summary = _pooled(VIOL, 7, 16, 64, 32, 320)
+    for k in ("compile_s", "dispatch_gap_s", "device_wait_s",
+              "host_overlap_s"):
+        assert k in summary and summary[k] >= 0, (k, summary)
+    # the device loop is device-bound here: the gap (host-caused wall
+    # between dispatches) must be a small fraction of the device wall
+    assert summary["dispatch_gap_s"] < summary["wall_s"]
+
+
+def test_pool_on_retired_exception_propagates():
+    # the consumer thread must not swallow an emitter crash: the exception
+    # surfaces on the calling thread and the pool shuts down cleanly
+    def boom(row):
+        raise RuntimeError("emitter died")
+
+    with pytest.raises(RuntimeError, match="emitter died"):
+        run_pool(VIOL, 3, 16, 64, chunk_ticks=32, budget_ticks=64,
+                 on_retired=boom)
+
+
+def test_pool_devices_validation():
+    import jax
+
+    with pytest.raises(ValueError, match="divide evenly"):
+        run_pool(VIOL, 7, 15, 64, devices=2)
+    with pytest.raises(ValueError, match="exceeds"):
+        run_pool(VIOL, 7, 16, 64, devices=len(jax.devices()) + 1)
+    with pytest.raises(ValueError, match=">= 1"):
+        run_pool(VIOL, 7, 16, 64, devices=0)
 
 
 def test_pool_budget_seconds_terminates():
