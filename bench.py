@@ -125,7 +125,9 @@ def bench_raft(n_clusters: int, n_ticks: int, cfg: SimConfig) -> dict:
         return run_fn(seed)
 
     cold_s, final = _warmed(run, lambda s: np.asarray(s.violations))
-    state_bytes = sum(x.nbytes for x in jax.tree.leaves(final))
+    # the RESIDENT carry the chunk loop actually holds in HBM (packed when
+    # the run fits the packed bounds — ISSUE 9), measured from live buffers
+    state_bytes = run_fn.state_hbm_bytes
     best, runs, spread, final = _timed(run, lambda s: np.asarray(s.violations))
     rep = report(final)
     return {
@@ -136,6 +138,8 @@ def bench_raft(n_clusters: int, n_ticks: int, cfg: SimConfig) -> dict:
         "best_wall_s": round(best, 3),
         "run_spread": round(spread, 3),
         "compile_s": _compile_s(cold_s, best),
+        "state_layout": run_fn.state_layout,
+        "bytes_per_lane": run_fn.bytes_per_lane,
         "hbm_util_floor": round(
             2 * state_bytes * ticks / best / HBM_PEAK_BYTES_PER_S, 4
         ),
@@ -254,8 +258,13 @@ def bench_pool(n_lanes: int, budget_ticks: int) -> dict:
 
     fuzz_fn = make_chunked_fuzz_fn(cfg, n_lanes, budget_ticks)
     # warm with ONE chunk, not a full budget run: the chunk program's tick
-    # count is a runtime bound, so this compiles the identical executables
-    _warmed(lambda: make_chunked_fuzz_fn(cfg, n_lanes, chunk)(12345), sync)
+    # count is a runtime bound, so this compiles the identical executables —
+    # PROVIDED the warm-up runs the same state layout as the timed leg (a
+    # short warm run would auto-pack while the full-budget leg may exceed
+    # max_lane_ticks and fall back wide, warming the wrong programs)
+    _warmed(lambda: make_chunked_fuzz_fn(
+        cfg, n_lanes, chunk,
+        pack_states=(fuzz_fn.state_layout == "packed"))(12345), sync)
     t0 = time.perf_counter()
     final = fuzz_fn(12345)
     sync(final)
@@ -282,6 +291,13 @@ def bench_pool(n_lanes: int, budget_ticks: int) -> dict:
         "fuzz_wall_s": round(fuzz_wall, 3),
         "fuzz_viol_per_chip_s": round(fuzz_vps, 4),
         "fuzz_steps_per_sec": round(n_lanes * budget_ticks / fuzz_wall, 1),
+        # at full scale the fuzz leg's 12288-tick single lifetime exceeds
+        # the default max_lane_ticks bound and reports the wide fallback
+        # (the layout gate working as specified); at smoke budgets both
+        # legs pack — the row says which happened
+        "fuzz_state_layout": fuzz_fn.state_layout,
+        "pool_state_layout": summary["state_layout"],
+        "pool_bytes_per_lane": summary["bytes_per_lane"],
         "pool_violations": pool_viol,
         "pool_retired": summary["retired"],
         "pool_wall_s": pool_wall,
@@ -412,6 +428,32 @@ def bench_pool_scaling(n_lanes: int, budget_ticks: int) -> dict:
         return {"error": str(e)}
 
 
+def bench_state_footprint() -> dict:
+    """Per-lane resident-state footprint (ISSUE 9), wide vs packed, from
+    LIVE device buffers (never a schema estimate): the lanes-per-HBM story.
+    ``max_lanes_per_16g_shard_*`` divides a v5e-class 16 GiB HBM by the
+    double-buffered (donation) per-lane footprint — the table is a proxy
+    until the tunnel is back; the measurement method is chip-ready."""
+    from madraft_tpu.tpusim import state as stmod
+    from madraft_tpu.tpusim.config import packed_bounds
+
+    cfg = flagship_config()
+    s = stmod.init_cluster(cfg, jax.random.PRNGKey(0))
+    wide = stmod.tree_bytes(s)
+    packed = stmod.tree_bytes(stmod.pack_state(cfg, s))
+    hbm = 16 * (1 << 30)
+    return {
+        "config": f"{cfg.n_nodes}-node/log_cap {cfg.log_cap} (storm shape)",
+        "max_lane_ticks": cfg.max_lane_ticks,
+        "bounds": packed_bounds(cfg)._asdict(),
+        "wide_bytes_per_lane": wide,
+        "packed_bytes_per_lane": packed,
+        "reduction": round(wide / packed, 3),
+        "max_lanes_per_16g_shard_wide": hbm // (2 * wide),
+        "max_lanes_per_16g_shard_packed": hbm // (2 * packed),
+    }
+
+
 def bench_coverage(n_lanes: int, budget_ticks: int) -> dict:
     """Coverage-guided vs uniform-random A/B (ROADMAP item 3), two legs:
 
@@ -454,6 +496,20 @@ def bench_coverage(n_lanes: int, budget_ticks: int) -> dict:
     bg = leg(bug_cfg, dcc, horizon, budget_ticks, seed=1)
     br = leg(bug_cfg, dcc.replace(guided=False), horizon, budget_ticks,
              seed=1)
+    # the coverage-MODE cliff (ROADMAP 3d), re-measured on the packed
+    # layout: the same profile/budget through the plain pool (uniform
+    # scalar knobs) vs the coverage pool (per-lane knob rows + per-tick
+    # fingerprint) — the price of heterogeneous guided lanes
+    plain = run_pool(bug_cfg, 1, n_lanes, horizon, budget_ticks=budget_ticks)
+    cliff = {
+        "plain_pool_steps_per_sec": plain["steps_per_sec"],
+        "coverage_pool_steps_per_sec": br["steps_per_sec"],
+        "cliff_factor": (
+            round(plain["steps_per_sec"] / br["steps_per_sec"], 3)
+            if br["steps_per_sec"] else None
+        ),
+        "state_layout": br["state_layout"],
+    }
 
     def frac(s):
         return s["coverage"]["seen_fingerprints"] / total
@@ -462,6 +518,7 @@ def bench_coverage(n_lanes: int, budget_ticks: int) -> dict:
         return frac(s) / s["wall_s"] if s["wall_s"] > 0 else None
 
     return {
+        "knob_layout_cliff": cliff,
         "ground_truth": {
             "config": "3-node/64-tick/2-level alphabet",
             "enumerated_states": total,
@@ -568,6 +625,9 @@ def main() -> None:
     # ground-truth reached-fraction comparison plus the planted-bug leg;
     # a smaller budget than the pool row — two extra pool runs per leg
     covr = bench_coverage(max(64, n_clusters // 16), max(1200, 6 * n_ticks))
+    # per-lane resident-state footprint, wide vs packed (ISSUE 9): tracks
+    # the lanes-per-HBM trajectory from this round on
+    footprint = bench_state_footprint()
     steps_per_sec = raft.pop("steps_per_sec")
     print(
         json.dumps(
@@ -614,6 +674,8 @@ def main() -> None:
                         "state_ratio"
                     ],
                     "coverage": covr,
+                    "state_footprint_reduction": footprint["reduction"],
+                    "state_footprint": footprint,
                     "device": str(jax.devices()[0]),
                     **({"degraded": degraded} if degraded else {}),
                 },

@@ -96,13 +96,22 @@ print(f"explain smoke: {len(lines) - 1} events, "
       "fixed-seed fuzz golden OK")
 PY
 
-# pool smoke (ISSUE 5): the continuous retire-and-refill pool on the
-# durability profile. The planted-bug leg must retire >= 1 violating
-# cluster within its budget and exit 1 (violations are findings, like
-# fuzz); the clean leg must retire everything at the horizon and exit 0.
+# pool smoke (ISSUE 5 + ISSUE 9 packed path): the continuous
+# retire-and-refill pool on the durability profile, which now carries the
+# PACKED state layout (the golden file above already pins that the packed
+# carry retires bit-identical clusters). The planted-bug leg must retire
+# >= 1 violating cluster within its budget and exit 1 (violations are
+# findings, like fuzz); the clean leg must retire everything at the
+# horizon and exit 0. Both legs must report state_layout "packed" and a
+# bytes_per_lane under the regression bound — 2597 B measured at the
+# 5-node/log_cap-64 storm shape (PERF.md round 9); the 2800 ceiling keeps
+# a later PR from silently re-widening a field back toward the 5437 B
+# wide layout.
 MADTPU_PLATFORM=cpu python - <<'PY'
 import contextlib, io, json
 from madraft_tpu.__main__ import main
+
+BYTES_PER_LANE_BOUND = 2800  # wide layout is 5437 B at this shape
 
 buf = io.StringIO()
 with contextlib.redirect_stdout(buf):
@@ -113,6 +122,11 @@ lines = [json.loads(x) for x in buf.getvalue().strip().splitlines()]
 summary = lines[-1]
 assert rc == 1, f"pool bug leg exit {rc} != 1"
 assert summary["retired_violating"] >= 1, summary
+assert summary["state_layout"] == "packed", summary
+assert summary["bytes_per_lane"] <= BYTES_PER_LANE_BOUND, (
+    f"packed state re-widened: {summary['bytes_per_lane']} B/lane > "
+    f"{BYTES_PER_LANE_BOUND} (wide is 5437)"
+)
 rows = [r for r in lines[:-1] if r.get("violations")]
 assert rows and rows[0]["cluster_id"] in summary["violating_clusters"], rows
 
@@ -124,8 +138,10 @@ with contextlib.redirect_stdout(buf):
 summary = json.loads(buf.getvalue().strip().splitlines()[-1])
 assert rc == 0, f"pool clean leg exit {rc} != 0"
 assert summary["retired_violating"] == 0 and summary["retired"] == 64, summary
+assert summary["state_layout"] == "packed", summary
 print(f"pool smoke: bug leg retired {len(rows)} violating "
-      f"(first={rows[0]['cluster_id']}), clean leg 64/64 at horizon")
+      f"(first={rows[0]['cluster_id']}), clean leg 64/64 at horizon, "
+      f"packed layout at {summary['bytes_per_lane']} B/lane")
 PY
 
 # coverage smoke (ISSUE 6): the coverage-GUIDED pool on the planted-bug

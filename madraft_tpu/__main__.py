@@ -180,7 +180,8 @@ def _finish_fuzz(args, fn, rep_fn):
     from madraft_tpu.tpusim.engine import run_telemetry
 
     rep, tele = run_telemetry(
-        fn, rep_fn, args.seed, args.clusters * args.ticks
+        fn, rep_fn, args.seed, args.clusters * args.ticks,
+        n_lanes=args.clusters,
     )
 
     def run():
@@ -487,7 +488,8 @@ def cmd_sweep(args):
 
     from madraft_tpu.tpusim.engine import run_telemetry
 
-    rep, tele = run_telemetry(fn, report, args.seed, n * args.ticks)
+    rep, tele = run_telemetry(fn, report, args.seed, n * args.ticks,
+                              n_lanes=n)
     extra, det_failed = _det_check(args, rep, run)
     extra["telemetry"] = tele
     cells = []
@@ -515,19 +517,39 @@ def cmd_sweep(args):
     return 1 if (rep.n_violating or det_failed) else 0
 
 
+def _state_schema(cfg, knobs, ticks: int) -> dict:
+    """The trace/replay artifact schema stamp (MIGRATION.md "State layout"):
+    which packed-state schema version this build writes, and which layout
+    the run actually carried (the engine's one layout rule). Called only
+    after the replay succeeded, so the knobs are known-valid."""
+    from madraft_tpu.tpusim.engine import resolve_knobs
+    from madraft_tpu.tpusim.state import (
+        STATE_SCHEMA_VERSION,
+        packed_layout_reason,
+    )
+
+    packed = packed_layout_reason(cfg, resolve_knobs(cfg, knobs), ticks) is None
+    return {
+        "state_schema_version": STATE_SCHEMA_VERSION,
+        "state_layout": "packed" if packed else "wide",
+    }
+
+
 def cmd_replay(args):
     import numpy as np
 
     from madraft_tpu.tpusim.config import violation_names
     from madraft_tpu.tpusim.engine import replay_cluster
 
+    cfg = _sim_config(args)
     knobs = _knobs_json("replay", args.knobs_json)
     st = _replay_or_usage_error(
-        "replay", replay_cluster, _sim_config(args), args.seed, args.cluster,
+        "replay", replay_cluster, cfg, args.seed, args.cluster,
         args.ticks, knobs=knobs)
     print(json.dumps({
         "seed": args.seed,
         "cluster": args.cluster,
+        **_state_schema(cfg, knobs, args.ticks),
         "violations": int(st.violations),
         "violation_names": violation_names(int(st.violations)),
         "first_violation_tick": int(st.first_violation_tick),
@@ -562,6 +584,7 @@ def cmd_explain(args):
         "seed": args.seed,
         "cluster": args.cluster,
         "ticks": args.ticks,
+        **_state_schema(cfg, knobs, args.ticks),
         "violations": viol,
         "violation_names": violation_names(viol),
         "first_violation_tick": fvt,

@@ -47,6 +47,21 @@ class SimConfig:
     #                          (power of two: canonical lane = (index-1) & (cap-1))
     ae_max: int = 4          # max entries carried per AppendEntries message
 
+    # Packed-state tick ceiling (ISSUE 9): the per-lane tick count the
+    # PACKED ClusterState layout (state.PackedClusterState) is sized for.
+    # Every tick-derived quantity is bounded by it — term bumps at most
+    # once per tick cluster-wide, the log grows at most 2 entries per tick
+    # (leader no-op + injection on a win tick), and command values are
+    # next_cmd * n_nodes + node + 1 with next_cmd <= ticks — so the packed
+    # dtypes are DERIVED from this one bound (config.packed_bounds is the
+    # single source of truth; state.packed_spec turns bounds into dtypes,
+    # and tests/test_state_layout.py pins the derivation). A run whose
+    # per-lane horizon exceeds it simply uses the wide i32 layout
+    # (engine/trace report which via `state_layout`); exceeding the bound
+    # on the packed path is impossible by construction, not UB. Static
+    # (shapes the compiled programs' dtypes), so it joins static_key.
+    max_lane_ticks: int = 4096
+
     def __post_init__(self):
         if self.log_cap <= 0 or self.log_cap & (self.log_cap - 1):
             raise ValueError(f"log_cap must be a power of two, got {self.log_cap}")
@@ -72,6 +87,12 @@ class SimConfig:
         if not 0.0 <= self.p_lose_unsynced <= 1.0:
             raise ValueError(
                 f"p_lose_unsynced outside [0, 1]: {self.p_lose_unsynced}"
+            )
+        # cmd bound n * (T + 1) must stay < 2^31 for the widest derived
+        # dtype (and leave the wide-i32 layout itself sound)
+        if not 1 <= self.max_lane_ticks <= (1 << 24):
+            raise ValueError(
+                f"max_lane_ticks outside [1, 2^24]: {self.max_lane_ticks}"
             )
 
     # Log compaction (the Lab 2D snapshot path, raft.rs:149-168): a node
@@ -211,7 +232,7 @@ class SimConfig:
         flow/compaction margin check satisfiable at any log_cap)."""
         return SimConfig(
             n_nodes=self.n_nodes, log_cap=self.log_cap, ae_max=self.ae_max,
-            compact_every=1, bug=self.bug,
+            max_lane_ticks=self.max_lane_ticks, compact_every=1, bug=self.bug,
         )
 
 
@@ -247,6 +268,54 @@ class Knobs(NamedTuple):
     def broadcast(self, n_clusters: int) -> "Knobs":
         """Per-cluster copies (leading axis) for vmap'ing over clusters."""
         return Knobs(*(jnp.broadcast_to(x, (n_clusters,)) for x in self))
+
+
+# ---------------------------------------------------------------------------
+# Packed state layout bounds (ISSUE 9; the schema itself lives in state.py).
+#
+# The packed ClusterState narrows every cold field to the smallest dtype its
+# CONFIGURED range admits. The ranges all derive from SimConfig — this
+# function is the one place the derivation lives, so the schema, the engine's
+# layout choice, and the width-pinning tests cannot disagree about what fits:
+#
+#   tick   <= max_lane_ticks              (T; the declared per-lane ceiling)
+#   term   <= T                           (cluster-wide max term bumps at most
+#                                          once per tick — only an election
+#                                          timeout increments it)
+#   index  <= 2 * T + 1                   (log_len grows at most 2/tick:
+#                                          leader no-op + injection on a win
+#                                          tick; next_idx <= log_len + 1)
+#   cmd    <= n_nodes * (T + 1)           (cmd_val = next_cmd * n + me + 1,
+#                                          next_cmd <= T; NOOP_CMD is encoded
+#                                          as the dtype's reserved max)
+#
+# Mailbox delivery STAMPS are stored relative to the cluster tick (every
+# live slot holds a future tick; the per-send delay is < 256 by the
+# _net_draws packed-draw contract), so they fit one byte regardless of T —
+# provided delay_max <= 253, which state.packed_layout_reason checks along
+# with the other dynamic-knob ceilings (timer/heartbeat fit u16).
+# ---------------------------------------------------------------------------
+
+
+class PackedBounds(NamedTuple):
+    """Largest value each packed field class must represent (inclusive)."""
+
+    tick: int    # tick, next_cmd, and every term-valued field
+    term: int
+    index: int   # log_len/base/commit/next_idx/match/prev/... (absolute)
+    cmd: int     # log_val/shadow_val payloads (excluding the NOOP sentinel)
+    rel_stamp: int  # mailbox stamp minus cluster tick (0 = empty slot)
+
+
+def packed_bounds(cfg: "SimConfig") -> PackedBounds:
+    t = cfg.max_lane_ticks
+    return PackedBounds(
+        tick=t,
+        term=t,
+        index=2 * t + 1,
+        cmd=cfg.n_nodes * (t + 1),
+        rel_stamp=254,  # u8 with 0 reserved for "empty" => delay_max <= 253
+    )
 
 
 # Violation bitmask values (oracle reductions; raft oracles live in step.py,

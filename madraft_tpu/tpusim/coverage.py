@@ -50,7 +50,11 @@ from madraft_tpu.tpusim.config import (
     Knobs,
     pool_lanes_per_shard,
 )
-from madraft_tpu.tpusim.state import ClusterState, abstract_node_tuple
+from madraft_tpu.tpusim.state import (
+    ClusterState,
+    PackedClusterState,
+    abstract_node_tuple,
+)
 
 U32 = jnp.uint32
 
@@ -93,24 +97,64 @@ def identity_mapped(n_nodes: int, ccfg: CoverageConfig) -> bool:
     return code_space(n_nodes, ccfg) <= ccfg.bitmap_bits
 
 
-def abstract_code(ccfg: CoverageConfig, s: ClusterState) -> jax.Array:
-    """u32 abstract-state code of ONE cluster at its current tick (vmap adds
-    the lane axis). Big-endian fold of the per-node values by node id —
-    injective whenever the code space fits u32, and u32-wraparound (harmless:
-    the non-identity path mixes anyway) beyond that."""
-    role, alive, rank, delta = abstract_node_tuple(
-        s, ccfg.term_rank_levels, ccfg.commit_delta_levels
-    )
-    node_code = (
+def _combine_node_code(ccfg: CoverageConfig, role, alive, rank, delta):
+    """The per-node quantized tuple -> one code in [0, node_alphabet) —
+    like _fold_code, ONE spelling shared by the wide and packed
+    fingerprints (and mirrored by enumerate_abstract_codes' host loop)."""
+    return (
         ((role * 2 + alive) * ccfg.term_rank_levels + rank)
         * ccfg.commit_delta_levels + delta
-    ).astype(U32)
+    )
+
+
+def _fold_code(ccfg: CoverageConfig, node_code: jax.Array) -> jax.Array:
+    """Big-endian fold of per-node u32 codes by node id — injective whenever
+    the code space fits u32, and u32-wraparound (harmless: the non-identity
+    path mixes anyway) beyond that. ONE fold for the wide and packed
+    fingerprints, so the two spellings cannot diverge."""
     n = node_code.shape[0]  # static
     a = node_alphabet(ccfg)
     weights = jnp.asarray(
         [pow(a, n - 1 - i, 1 << 32) for i in range(n)], U32
     )
     return jnp.sum(node_code * weights, dtype=U32)
+
+
+def abstract_code(ccfg: CoverageConfig, s: ClusterState) -> jax.Array:
+    """u32 abstract-state code of ONE cluster at its current tick (vmap adds
+    the lane axis)."""
+    role, alive, rank, delta = abstract_node_tuple(
+        s, ccfg.term_rank_levels, ccfg.commit_delta_levels
+    )
+    return _fold_code(
+        ccfg, _combine_node_code(ccfg, role, alive, rank, delta).astype(U32)
+    )
+
+
+def abstract_code_packed(
+    ccfg: CoverageConfig, p: PackedClusterState
+) -> jax.Array:
+    """``abstract_code`` folded DIRECTLY from the packed schema (ISSUE 9):
+    role and alive are read straight out of their bitfield words — the
+    packed layout already stores exactly the 2-bit/1-bit alphabet the
+    fingerprint quantizes to — and term-rank/commit-delta come from the
+    narrow term/commit arrays (comparisons and the bounded delta are exact
+    in the narrow dtype: commit - min(commit) is non-negative and clipped
+    below the dtype's range). Produces the IDENTICAL code for the
+    round-tripped state (tests/test_state_layout.py pins it), so guided
+    search is layout-blind."""
+    n = p.term.shape[0]
+    idx = jnp.arange(n, dtype=U32)
+    role = ((p.role_bits >> (2 * idx)) & 3).astype(U32)
+    alive = ((p.alive_bits >> idx) & 1).astype(U32)
+    rank = jnp.clip(
+        jnp.sum(p.term[None, :] < p.term[:, None], axis=1),
+        0, ccfg.term_rank_levels - 1,
+    ).astype(U32)
+    delta = jnp.clip(
+        p.commit - jnp.min(p.commit), 0, ccfg.commit_delta_levels - 1
+    ).astype(U32)
+    return _fold_code(ccfg, _combine_node_code(ccfg, role, alive, rank, delta))
 
 
 def _mix32(x: jax.Array) -> jax.Array:
@@ -233,8 +277,8 @@ def enumerate_abstract_codes(n_nodes: int, ccfg: CoverageConfig) -> np.ndarray:
             continue
         code = 0
         for role, alive, rank, delta in combo:
-            code = code * node_alphabet(ccfg) + (
-                ((role * 2 + alive) * levels_r + rank) * levels_c + delta
+            code = code * node_alphabet(ccfg) + _combine_node_code(
+                ccfg, role, alive, rank, delta
             )
         codes.append(code)
     return np.asarray(sorted(codes), np.uint32)

@@ -25,8 +25,15 @@ from madraft_tpu.tpusim.config import (
     pool_lanes_per_shard,
     violation_names,
 )
-from madraft_tpu.tpusim.state import ClusterState, init_cluster
-from madraft_tpu.tpusim.step import step_cluster
+from madraft_tpu.tpusim.state import (
+    ClusterState,
+    init_cluster,
+    pack_state,
+    packed_layout_reason,
+    tree_bytes,
+    unpack_state,
+)
+from madraft_tpu.tpusim.step import step_cluster, step_cluster_packed
 
 CLUSTER_AXIS = "clusters"
 
@@ -179,19 +186,25 @@ class FuzzProgram:
         return self._prog(*args)
 
 
-def run_telemetry(fn, rep_fn, seed, n_steps: int) -> tuple:
+def run_telemetry(fn, rep_fn, seed, n_steps: int,
+                  n_lanes: Optional[int] = None) -> tuple:
     """Shared CLI-report telemetry runner: AOT-compile ``fn`` (timed), run
     it (timed), and return ``(report, telemetry_dict)``. ``rep_fn`` maps the
     final device state to the host report and is included in execute time —
     it contains the device->host sync that makes the measurement honest
-    (bench.py methodology)."""
+    (bench.py methodology). ``n_lanes`` (when given) adds the state-
+    footprint telemetry (ISSUE 9): total bytes of the final state's LIVE
+    device buffers and the per-lane share, plus which layout the run
+    carried (``fn.state_layout`` when the runner packs its carry; the
+    single-program fuzz/sweep paths stay wide)."""
     import jax as _jax
 
     # duck-typed: FuzzProgram and the sweep's uniform dispatch both expose
     # the AOT compile/execute split
     compile_s = fn.compile_timed(seed) if hasattr(fn, "compile_timed") else None
     t0 = time.perf_counter()
-    rep = rep_fn(_jax.block_until_ready(fn(seed)))
+    final = _jax.block_until_ready(fn(seed))
+    rep = rep_fn(final)
     execute_s = time.perf_counter() - t0
     dev = _jax.devices()[0]
     tele = {
@@ -200,6 +213,16 @@ def run_telemetry(fn, rep_fn, seed, n_steps: int) -> tuple:
         "device": str(dev),
         "backend": dev.platform,
     }
+    if n_lanes:
+        # a packing runner's RESIDENT carry bytes win over the final state
+        # it returns (make_chunked_fuzz_fn always widens the final, so
+        # tree_bytes(final) would report the wide footprint under a packed
+        # layout label); single-program fuzz/sweep runners expose neither
+        # attribute and their final state IS the resident state
+        sb = getattr(fn, "state_hbm_bytes", None) or tree_bytes(final)
+        tele["state_layout"] = getattr(fn, "state_layout", "wide")
+        tele["state_hbm_bytes"] = sb
+        tele["bytes_per_lane"] = round(sb / n_lanes, 1)
     if compile_s is not None:
         tele["compile_s"] = round(compile_s, 4)
     else:
@@ -527,20 +550,32 @@ def default_chunk_ticks(horizon: int) -> int:
     return -(-horizon // k)
 
 
+def _fresh_batch(static_cfg: SimConfig, keys, kn, kn_axis, packed: bool):
+    """init_cluster over a key batch, in the requested layout — the ONE
+    spelling of "make fresh lanes" shared by the init and every harvest
+    program, so the packed schema cannot drift between birth sites."""
+    states = jax.vmap(
+        functools.partial(init_cluster, static_cfg), in_axes=(0, kn_axis)
+    )(keys, kn)
+    if packed:
+        states = jax.vmap(functools.partial(pack_state, static_cfg))(states)
+    return states
+
+
 @functools.lru_cache(maxsize=None)
 def _pool_init_program(static_cfg: SimConfig, n_clusters: int,
-                       mesh: Optional[Mesh]):
+                       mesh: Optional[Mesh], packed: bool = False):
     """(seed, kn, id0) -> (states, keys, ids): a fresh batch covering global
     cluster ids [id0, id0 + n). Identical init math to _fuzz_program, split
-    out so the chunk loop can carry states across compiled calls."""
+    out so the chunk loop can carry states across compiled calls. With
+    ``packed`` the returned states are the PackedClusterState carry (ISSUE
+    9) — the chunk/harvest programs must be built with the same flag."""
     constraint = _constraint(mesh)
 
     def run(seed, kn, id0):
         ids = jnp.arange(n_clusters, dtype=jnp.int32) + id0
         keys = _cluster_keys(seed, n_clusters, id0)
-        states = jax.vmap(
-            functools.partial(init_cluster, static_cfg), in_axes=(0, None)
-        )(keys, kn)
+        states = _fresh_batch(static_cfg, keys, kn, None, packed)
         if constraint is not None:
             states = jax.lax.with_sharding_constraint(
                 states, jax.tree.map(lambda _: constraint, states)
@@ -553,22 +588,38 @@ def _pool_init_program(static_cfg: SimConfig, n_clusters: int,
 
 
 @functools.lru_cache(maxsize=None)
-def _chunk_program(static_cfg: SimConfig, n_clusters: int):
+def _chunk_program(static_cfg: SimConfig, n_clusters: int,
+                   packed: bool = False):
     """T ticks of the batched step with a DONATED state carry — one
     implementation for bench/CLI/pool. The tick count is a runtime
     fori_loop bound, so one compiled program serves every chunk length
-    (full chunks, the remainder chunk, and any pool chunk size)."""
+    (full chunks, the remainder chunk, and any pool chunk size). With
+    ``packed`` the carry is the narrow-dtype PackedClusterState and each
+    tick widens-on-use (step_cluster_packed) — the HBM-resident share of
+    the loop is the packed footprint, the arithmetic is unchanged i32."""
+    step_fn = step_cluster_packed if packed else step_cluster
 
     def run(states, keys, kn, n_ticks):
         def body(_, carry):
             return jax.vmap(
-                functools.partial(step_cluster, static_cfg),
+                functools.partial(step_fn, static_cfg),
                 in_axes=(0, 0, None),
             )(carry, keys, kn)
 
         return jax.lax.fori_loop(0, n_ticks, body, states)
 
     return jax.jit(run, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _unpack_batch_program(static_cfg: SimConfig, n_clusters: int):
+    """Packed carry -> wide batched ClusterState (donated input): the one
+    widening at the END of a packed chunked-fuzz run, so callers keep
+    receiving the historic wide final state."""
+    return jax.jit(
+        lambda p: jax.vmap(functools.partial(unpack_state, static_cfg))(p),
+        donate_argnums=(0,),
+    )
 
 
 def _retire_and_reseed(states, ids, next_id, seed, horizon):
@@ -597,23 +648,34 @@ def _scatter_fresh(retired, fresh, states):
     return jax.tree.map(sel, fresh, states)
 
 
+def _wide_view(static_cfg: SimConfig, states, packed: bool):
+    """The wide view of a (possibly packed) batched carry — what the
+    retirement rule and the report snapshot read. XLA dead-code-eliminates
+    the unpacking of fields a program never touches."""
+    if not packed:
+        return states
+    return jax.vmap(functools.partial(unpack_state, static_cfg))(states)
+
+
 @functools.lru_cache(maxsize=None)
-def _harvest_program(static_cfg: SimConfig, n_clusters: int):
+def _harvest_program(static_cfg: SimConfig, n_clusters: int,
+                     packed: bool = False):
     """Harvest + refill, one compiled call (states donated): snapshot the
     small per-slot report arrays, then scatter freshly init_cluster-ed
     states into retired lanes under new global ids next_id, next_id+1, ...
     (see _retire_and_reseed). Single-device by construction — the monotone
     id rank is a batch-wide cumsum; the sharded pool uses
-    _lane_harvest_program instead."""
+    _lane_harvest_program instead. With ``packed`` the carried states are
+    PackedClusterState rows: retire/snapshot read the widened view and the
+    refill scatters freshly PACKED lanes, so the carry never widens."""
 
     def run(states, keys, ids, next_id, seed, kn, horizon):
+        wide = _wide_view(static_cfg, states, packed)
         retired, new_ids, new_keys, n_ret = _retire_and_reseed(
-            states, ids, next_id, seed, horizon
+            wide, ids, next_id, seed, horizon
         )
-        harvest = _pool_snapshot(states, retired, ids)
-        fresh = jax.vmap(
-            functools.partial(init_cluster, static_cfg), in_axes=(0, None)
-        )(new_keys, kn)
+        harvest = _pool_snapshot(wide, retired, ids)
+        fresh = _fresh_batch(static_cfg, new_keys, kn, None, packed)
         states_out = _scatter_fresh(retired, fresh, states)
         return states_out, new_keys, new_ids, next_id + n_ret, harvest
 
@@ -677,23 +739,22 @@ def _pool_snapshot(states, retired, ids) -> PoolHarvest:
 
 @functools.lru_cache(maxsize=None)
 def _lane_harvest_program(static_cfg: SimConfig, n_clusters: int,
-                          mesh: Optional[Mesh]):
+                          mesh: Optional[Mesh], packed: bool = False):
     """Harvest + refill under the lane-partitioned id scheme (states
     donated): same report snapshot and scatter as _harvest_program, but the
     refill bookkeeping is the per-lane generation bump of _lane_reseed —
     no cross-shard collective reaches the compiled program. A SEPARATE
     cached program: the monotone pool's HLO (and golden guard) is
-    untouched."""
+    untouched. ``packed`` as in _harvest_program."""
     constraint = _constraint(mesh)
 
     def run(states, keys, ids, gens, seed, kn, horizon):
+        wide = _wide_view(static_cfg, states, packed)
         retired, new_ids, new_keys, gens_new = _lane_reseed(
-            states, ids, gens, seed, horizon, n_clusters
+            wide, ids, gens, seed, horizon, n_clusters
         )
-        harvest = _pool_snapshot(states, retired, ids)
-        fresh = jax.vmap(
-            functools.partial(init_cluster, static_cfg), in_axes=(0, None)
-        )(new_keys, kn)
+        harvest = _pool_snapshot(wide, retired, ids)
+        fresh = _fresh_batch(static_cfg, new_keys, kn, None, packed)
         if constraint is not None:
             fresh = jax.lax.with_sharding_constraint(
                 fresh, jax.tree.map(lambda _: constraint, fresh)
@@ -738,17 +799,24 @@ def _shard_put(tree, mesh: Optional[Mesh]):
 
 def _summary_fields(compile_s: float, gap: float, wait: float,
                     overlap: float, devices: Optional[int], book,
-                    n_clusters: int) -> tuple:
+                    n_clusters: int, layout: str = "wide",
+                    state_bytes: int = 0) -> tuple:
     """The pipeline-telemetry and id-scheme summary fields shared by the
     plain and coverage pool bodies — one copy, so the two summaries cannot
     drift. ``book`` is the final id bookkeeping carry: per-lane generation
     counters under the lane scheme, the monotone next-id scalar otherwise.
-    The three pipeline timers are defined at ``_pipeline``."""
+    The three pipeline timers are defined at ``_pipeline``; ``layout`` /
+    ``state_bytes`` are the resident-lane-state footprint (ISSUE 9):
+    measured from the LIVE carry buffers at init, never estimated from the
+    schema."""
     tele = {
         "compile_s": round(compile_s, 4),
         "dispatch_gap_s": round(gap, 4),
         "device_wait_s": round(wait, 4),
         "host_overlap_s": round(overlap, 4),
+        "state_layout": layout,
+        "state_hbm_bytes": state_bytes,
+        "bytes_per_lane": round(state_bytes / n_clusters, 1),
     }
     if devices is not None:
         # id-space watermark: every id ever drawn is < (max generation + 1)
@@ -761,22 +829,53 @@ def _summary_fields(compile_s: float, gap: float, wait: float,
     return tele, id_fields
 
 
+def _choose_layout(cfg: SimConfig, kn, ticks_needed: int,
+                   pack_states: Optional[bool]) -> tuple:
+    """The ONE layout-choice rule for every packed-capable program
+    (chunked fuzz, pool, coverage pool; trace/replay apply the same rule
+    through state.packed_layout_reason directly): auto-pack when the packed
+    schema is exact for the run, fall back to wide otherwise — and refuse a
+    FORCED pack that would be inexact, because a silently-wrapping narrow
+    dtype corrupts trajectories instead of failing a bound. Returns
+    (packed, layout_string)."""
+    reason = packed_layout_reason(cfg, kn, ticks_needed)
+    if pack_states is None:
+        packed = reason is None
+    elif pack_states and reason is not None:
+        raise ValueError(f"pack_states=True but the packed layout is not "
+                         f"exact for this run: {reason}")
+    else:
+        packed = bool(pack_states)
+    return packed, ("packed" if packed else "wide")
+
+
 def make_chunked_fuzz_fn(
     cfg: SimConfig,
     n_clusters: int,
     n_ticks: int,
     chunk_ticks: int = CHUNK_TICKS,
     mesh: Optional[Mesh] = None,
+    pack_states: Optional[bool] = None,
 ):
     """fn(seed) -> final batched ClusterState via a host loop over donated
     compiled chunks (bench.py methodology: a single device execution stays
     well under the tunnel's per-call deadline; donate_argnums reuses the
     state double-buffer). Bit-identical to make_fuzz_fn's single program —
-    the chunk body is the same vmapped step under the same keys."""
+    the chunk body is the same vmapped step under the same keys.
+
+    ``pack_states``: None (default) carries the loop state in the PACKED
+    schema whenever it is exact for this run (state.packed_layout_reason —
+    the run fits cfg.max_lane_ticks and the knob ceilings); True forces it
+    (ValueError when inexact); False forces the historic wide carry. The
+    final state returned is ALWAYS wide. After the first call the returned
+    fn carries ``state_layout`` / ``state_hbm_bytes`` / ``bytes_per_lane``
+    attributes measured from the live resident carry buffers."""
     static = cfg.static_key()
-    init = _pool_init_program(static, n_clusters, mesh)
-    chunk = _chunk_program(static, n_clusters)
     kn = cfg.knobs()
+    packed, run_layout = _choose_layout(cfg, kn, n_ticks, pack_states)
+    init = _pool_init_program(static, n_clusters, mesh, packed)
+    chunk = _chunk_program(static, n_clusters, packed)
+    unpack = _unpack_batch_program(static, n_clusters) if packed else None
     sizes = [chunk_ticks] * (n_ticks // chunk_ticks)
     if n_ticks % chunk_ticks or not sizes:
         sizes.append(n_ticks % chunk_ticks or n_ticks)
@@ -785,10 +884,13 @@ def make_chunked_fuzz_fn(
         states, keys, _ = init(
             jnp.asarray(seed, jnp.uint32), kn, jnp.asarray(0, jnp.int32)
         )
+        run.state_hbm_bytes = tree_bytes(states)  # live resident buffers
+        run.bytes_per_lane = round(run.state_hbm_bytes / n_clusters, 1)
         for s in sizes:
             states = chunk(states, keys, kn, jnp.asarray(s, jnp.int32))
-        return states
+        return unpack(states) if packed else states
 
+    run.state_layout = run_layout
     return run
 
 
@@ -804,6 +906,7 @@ def run_pool(
     devices: Optional[int] = None,
     on_retired=None,
     coverage: Optional[CoverageConfig] = None,
+    pack_states: Optional[bool] = None,
 ) -> dict:
     """Continuous fuzzing pool: chunk -> harvest -> refill until the budget
     is spent. ``n_clusters`` lanes stay resident on device; a lane retires
@@ -842,6 +945,16 @@ def run_pool(
     ``_run_pool_coverage``. With ``devices`` the seen-set is PER-SHARD
     (one bitmap row per shard, OR-reduced at harvest/summary time), so
     coverage composes with the mesh.
+
+    ``pack_states``: the packed-carry choice (ISSUE 9; see
+    make_chunked_fuzz_fn). None auto-packs whenever the schema is exact for
+    ``horizon + chunk_ticks`` per-lane ticks (a lane can overshoot the
+    horizon by at most one chunk before the harvest retires it); the
+    summary's ``state_layout`` / ``state_hbm_bytes`` / ``bytes_per_lane``
+    report the layout and the measured live-buffer footprint of the
+    resident lane state. Reports are bit-identical across layouts (the
+    widen-on-use round trip is exact on the packed path — golden-guard
+    property, tests/test_state_layout.py).
     """
     if horizon < 1:
         raise ValueError(f"pool horizon must be >= 1 tick, got {horizon}")
@@ -855,15 +968,17 @@ def run_pool(
             cfg, seed, n_clusters, horizon, coverage,
             chunk_ticks=chunk_ticks, budget_ticks=budget_ticks,
             budget_seconds=budget_seconds, mesh=mesh, devices=devices,
-            on_retired=on_retired,
+            on_retired=on_retired, pack_states=pack_states,
         )
     static = cfg.static_key()
     kn = cfg.knobs()
+    packed, layout = _choose_layout(cfg, kn, horizon + chunk_ticks,
+                                    pack_states)
     lane_ids = devices is not None
-    init = _pool_init_program(static, n_clusters, mesh)
-    chunk = _chunk_program(static, n_clusters)
-    harv = (_lane_harvest_program(static, n_clusters, mesh) if lane_ids
-            else _harvest_program(static, n_clusters))
+    init = _pool_init_program(static, n_clusters, mesh, packed)
+    chunk = _chunk_program(static, n_clusters, packed)
+    harv = (_lane_harvest_program(static, n_clusters, mesh, packed)
+            if lane_ids else _harvest_program(static, n_clusters, packed))
     seed_u = jnp.asarray(seed, jnp.uint32)
     hz = jnp.asarray(horizon, jnp.int32)
     ct = jnp.asarray(chunk_ticks, jnp.int32)
@@ -900,6 +1015,7 @@ def run_pool(
     jax.block_until_ready(wh().retired)
     compile_s = time.perf_counter() - t_warm
     states, keys, ids = init(seed_u, kn, jnp.asarray(0, jnp.int32))
+    state_bytes = tree_bytes(states)  # live resident carry buffers
     carry = [states, keys, ids, book0()]
     launch_chunk, launch_harvest = steps(carry, ct)
     acct = _PoolAccount(on_retired)
@@ -909,7 +1025,8 @@ def run_pool(
     )
     acct.finish()
     tele, id_fields = _summary_fields(
-        compile_s, gap, wait, overlap, devices, carry[3], n_clusters
+        compile_s, gap, wait, overlap, devices, carry[3], n_clusters,
+        layout, state_bytes,
     )
     return _pool_summary(n_clusters, horizon, chunk_ticks, lane_ticks,
                          acct, wall, tele, id_fields)
@@ -953,23 +1070,28 @@ class CovHarvest(NamedTuple):
 
 @functools.lru_cache(maxsize=None)
 def _cov_chunk_program(static_cfg: SimConfig, n_clusters: int,
-                       ccfg: CoverageConfig):
+                       ccfg: CoverageConfig, packed: bool = False):
     """The coverage chunk: T ticks of the batched step under PER-LANE knob
     rows, with every tick's post-step abstract-state fingerprint recorded in
     the seen-set and credited to its lane's new-fingerprint counter. Two
     lanes landing the same new bit in one tick both get credit
     (deterministic; the alternative needs a per-tick segment reduction for
     a tie nobody acts on). The state, bitmap, and counters are donated —
-    the pool's double-buffer discipline."""
+    the pool's double-buffer discipline. With ``packed`` the carry is the
+    narrow schema and the fingerprint is folded FROM THE PACKED WORDS
+    (coverage.abstract_code_packed — role/alive read straight out of their
+    bitfield words; identical codes, test-pinned)."""
+    step_fn = step_cluster_packed if packed else step_cluster
+    code_fn = _cov.abstract_code_packed if packed else _cov.abstract_code
 
     def run(states, keys, kn_lanes, bitmap, new_fps, n_ticks):
         def body(_, carry):
             st, bm, nf = carry
             st = jax.vmap(
-                functools.partial(step_cluster, static_cfg),
+                functools.partial(step_fn, static_cfg),
                 in_axes=(0, 0, 0),
             )(st, keys, kn_lanes)
-            code = jax.vmap(functools.partial(_cov.abstract_code, ccfg))(st)
+            code = jax.vmap(functools.partial(code_fn, ccfg))(st)
             idx = _cov.bitmap_index(ccfg, static_cfg.n_nodes, code)
             # a violated lane's post-violation states are waste, not
             # coverage (the effective_cluster_steps convention): until the
@@ -989,7 +1111,7 @@ def _cov_chunk_program(static_cfg: SimConfig, n_clusters: int,
 
 @functools.lru_cache(maxsize=None)
 def _cov_harvest_program(static_cfg: SimConfig, n_clusters: int,
-                         ccfg: CoverageConfig):
+                         ccfg: CoverageConfig, packed: bool = False):
     """Harvest + BIASED refill, one compiled call (states donated): same
     retirement rule and monotone global-id scheme as _harvest_program, plus
     the corpus-scheduler policy — a retiring lane that discovered new
@@ -997,23 +1119,17 @@ def _cov_harvest_program(static_cfg: SimConfig, n_clusters: int,
     (coverage.refill_knobs), an unproductive one with a fresh prior draw.
     With ``ccfg.guided`` False the refill keeps every lane at the base knob
     row (measurement-only mode: trajectories identical to the plain pool —
-    the random A/B baseline and the first-generation golden guard)."""
+    the random A/B baseline and the first-generation golden guard).
+    ``packed`` as in _harvest_program."""
 
     def run(states, keys, ids, kn_lanes, kinds, new_fps, bitmap,
             next_id, seed, base_kn, horizon):
+        wide = _wide_view(static_cfg, states, packed)
         retired, new_ids, new_keys, n_ret = _retire_and_reseed(
-            states, ids, next_id, seed, horizon
+            wide, ids, next_id, seed, horizon
         )
         harvest = CovHarvest(
-            retired=retired,
-            ids=ids,
-            violations=states.violations,
-            first_violation_tick=states.first_violation_tick,
-            first_leader_tick=states.first_leader_tick,
-            committed=states.shadow_len,
-            msg_count=states.msg_count,
-            snap_installs=states.snap_install_count,
-            ticks_run=states.tick,
+            **_pool_snapshot(wide, retired, ids)._asdict(),
             new_fps=new_fps,
             refill_kind=kinds,
             seen_bits=jnp.sum(bitmap, dtype=jnp.int32),
@@ -1027,9 +1143,7 @@ def _cov_harvest_program(static_cfg: SimConfig, n_clusters: int,
             kinds_new = jnp.where(retired, drawn, kinds)
         else:
             kn_new, kinds_new = kn_lanes, kinds  # base rows forever
-        fresh = jax.vmap(
-            functools.partial(init_cluster, static_cfg), in_axes=(0, 0)
-        )(new_keys, kn_new)
+        fresh = _fresh_batch(static_cfg, new_keys, kn_new, 0, packed)
         states_out = _scatter_fresh(retired, fresh, states)
         new_fps_out = jnp.where(retired, 0, new_fps)
         return (states_out, new_keys, new_ids, kn_new, kinds_new,
@@ -1040,7 +1154,8 @@ def _cov_harvest_program(static_cfg: SimConfig, n_clusters: int,
 
 @functools.lru_cache(maxsize=None)
 def _cov_chunk_sharded_program(static_cfg: SimConfig, n_clusters: int,
-                               ccfg: CoverageConfig, n_shards: int):
+                               ccfg: CoverageConfig, n_shards: int,
+                               packed: bool = False):
     """_cov_chunk_program with a PER-SHARD seen-set (ROADMAP 3a): the
     bitmap is ``[n_shards, bitmap_bits]`` — one row per shard, sharded over
     the mesh axis with the lanes — and each lane reads/writes ONLY its own
@@ -1053,15 +1168,17 @@ def _cov_chunk_sharded_program(static_cfg: SimConfig, n_clusters: int,
     SEPARATE cached program: the single-device coverage pool's HLO is
     untouched."""
     shard_ix = _cov.lane_shards(n_clusters, n_shards)
+    step_fn = step_cluster_packed if packed else step_cluster
+    code_fn = _cov.abstract_code_packed if packed else _cov.abstract_code
 
     def run(states, keys, kn_lanes, bitmap, new_fps, n_ticks):
         def body(_, carry):
             st, bm, nf = carry
             st = jax.vmap(
-                functools.partial(step_cluster, static_cfg),
+                functools.partial(step_fn, static_cfg),
                 in_axes=(0, 0, 0),
             )(st, keys, kn_lanes)
-            code = jax.vmap(functools.partial(_cov.abstract_code, ccfg))(st)
+            code = jax.vmap(functools.partial(code_fn, ccfg))(st)
             idx = _cov.bitmap_index(ccfg, static_cfg.n_nodes, code)
             ok = st.violations == 0
             nf = nf + (ok & ~bm[shard_ix, idx]).astype(jnp.int32)
@@ -1078,7 +1195,8 @@ def _cov_chunk_sharded_program(static_cfg: SimConfig, n_clusters: int,
 @functools.lru_cache(maxsize=None)
 def _cov_harvest_sharded_program(static_cfg: SimConfig, n_clusters: int,
                                  ccfg: CoverageConfig,
-                                 mesh: Optional[Mesh]):
+                                 mesh: Optional[Mesh],
+                                 packed: bool = False):
     """_cov_harvest_program under the lane-partitioned id scheme: per-lane
     generation bookkeeping (_lane_reseed — no cross-shard scan), the same
     biased-refill policy (knob draws are a pure function of (seed, new
@@ -1090,11 +1208,12 @@ def _cov_harvest_sharded_program(static_cfg: SimConfig, n_clusters: int,
 
     def run(states, keys, ids, gens, kn_lanes, kinds, new_fps, bitmap,
             seed, base_kn, horizon):
+        wide = _wide_view(static_cfg, states, packed)
         retired, new_ids, new_keys, gens_new = _lane_reseed(
-            states, ids, gens, seed, horizon, n_clusters
+            wide, ids, gens, seed, horizon, n_clusters
         )
         harvest = CovHarvest(
-            **_pool_snapshot(states, retired, ids)._asdict(),
+            **_pool_snapshot(wide, retired, ids)._asdict(),
             new_fps=new_fps,
             refill_kind=kinds,
             seen_bits=jnp.sum(jnp.any(bitmap, axis=0), dtype=jnp.int32),
@@ -1108,9 +1227,7 @@ def _cov_harvest_sharded_program(static_cfg: SimConfig, n_clusters: int,
             kinds_new = jnp.where(retired, drawn, kinds)
         else:
             kn_new, kinds_new = kn_lanes, kinds  # base rows forever
-        fresh = jax.vmap(
-            functools.partial(init_cluster, static_cfg), in_axes=(0, 0)
-        )(new_keys, kn_new)
+        fresh = _fresh_batch(static_cfg, new_keys, kn_new, 0, packed)
         if constraint is not None:
             fresh = jax.lax.with_sharding_constraint(
                 fresh, jax.tree.map(lambda _: constraint, fresh)
@@ -1139,6 +1256,7 @@ def _run_pool_coverage(
     mesh: Optional[Mesh],
     devices: Optional[int],
     on_retired,
+    pack_states: Optional[bool] = None,
 ) -> dict:
     """run_pool's coverage-guided body (see run_pool for the contract).
 
@@ -1161,17 +1279,21 @@ def _run_pool_coverage(
     sharded = devices is not None
     static = cfg.static_key()
     base_kn = cfg.knobs()
-    init = _pool_init_program(static, n_clusters, mesh)
+    packed, layout = _choose_layout(cfg, base_kn, horizon + chunk_ticks,
+                                    pack_states)
+    init = _pool_init_program(static, n_clusters, mesh, packed)
     # the chunk only reads the fingerprint fields — cache it on those, so
     # the A/B's guided/random legs share one compiled chunk executable
     if sharded:
         chunk = _cov_chunk_sharded_program(
-            static, n_clusters, ccfg.fingerprint_key(), devices
+            static, n_clusters, ccfg.fingerprint_key(), devices, packed
         )
-        harv = _cov_harvest_sharded_program(static, n_clusters, ccfg, mesh)
+        harv = _cov_harvest_sharded_program(static, n_clusters, ccfg, mesh,
+                                            packed)
     else:
-        chunk = _cov_chunk_program(static, n_clusters, ccfg.fingerprint_key())
-        harv = _cov_harvest_program(static, n_clusters, ccfg)
+        chunk = _cov_chunk_program(static, n_clusters, ccfg.fingerprint_key(),
+                                   packed)
+        harv = _cov_harvest_program(static, n_clusters, ccfg, packed)
     seed_u = jnp.asarray(seed, jnp.uint32)
     hz = jnp.asarray(horizon, jnp.int32)
     ct = jnp.asarray(chunk_ticks, jnp.int32)
@@ -1226,6 +1348,7 @@ def _run_pool_coverage(
     jax.block_until_ready(wh().retired)
     compile_s = time.perf_counter() - t_warm
     carry = fresh_carry()
+    state_bytes = tree_bytes(carry[0])  # live resident carry buffers
     launch_chunk, launch_harvest = steps(carry, ct)
     acct = _PoolAccount(on_retired, guided=ccfg.guided)
     lane_ticks, wall, gap, wait, overlap = _pipeline(
@@ -1234,7 +1357,8 @@ def _run_pool_coverage(
     )
     acct.finish()
     tele, id_fields = _summary_fields(
-        compile_s, gap, wait, overlap, devices, carry[3], n_clusters
+        compile_s, gap, wait, overlap, devices, carry[3], n_clusters,
+        layout, state_bytes,
     )
     summary = _pool_summary(n_clusters, horizon, chunk_ticks, lane_ticks,
                             acct, wall, tele, id_fields)
@@ -1484,15 +1608,25 @@ def fuzz(
 
 
 @functools.lru_cache(maxsize=None)
-def _replay_program(static_cfg: SimConfig):
+def _replay_program(static_cfg: SimConfig, packed: bool = False):
+    """Single-cluster replay. With ``packed`` the fori carry is the packed
+    schema (the SAME one the pool/chunk programs carry — the replay path
+    shares the layout, ISSUE 9) and the returned final state is widened, so
+    callers always see the historic wide ClusterState — bit-identical
+    either way (exact round trip)."""
+    step_fn = step_cluster_packed if packed else step_cluster
+
     def run(cluster_id, kn, n_ticks, seed):
         ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
         state = init_cluster(static_cfg, ckey, kn)
+        if packed:
+            state = pack_state(static_cfg, state)
 
         def body(_, carry):
-            return step_cluster(static_cfg, carry, ckey, kn)
+            return step_fn(static_cfg, carry, ckey, kn)
 
-        return jax.lax.fori_loop(0, n_ticks, body, state)
+        final = jax.lax.fori_loop(0, n_ticks, body, state)
+        return unpack_state(static_cfg, final) if packed else final
 
     return jax.jit(run)
 
@@ -1550,9 +1684,13 @@ def replay_cluster(
     SAME compiled replay program either way, because knobs were always
     runtime scalars — exactly like replaying a sweep cell needs the cell's
     knob values, the (seed, cluster_id) PRNG-stream contract itself is
-    knob-independent."""
-    prog = _replay_program(cfg.static_key())
+    knob-independent. The carry uses the packed schema whenever it is
+    exact for this run (state.packed_layout_reason) — same layout rule as
+    the pool that produced the hit; the result is bit-identical in either
+    layout."""
     kn = resolve_knobs(cfg, knobs)
+    packed = packed_layout_reason(cfg, kn, n_ticks) is None
+    prog = _replay_program(cfg.static_key(), packed)
     return jax.block_until_ready(
         prog(jnp.asarray(cluster_id, jnp.int32), kn,
              jnp.asarray(n_ticks, jnp.int32), jnp.asarray(seed, jnp.uint32))

@@ -39,15 +39,33 @@ Design notes (vs the reference, SURVEY.md §2.6/§7):
 
 from __future__ import annotations
 
-from typing import NamedTuple
+import functools
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from madraft_tpu.tpusim.config import FOLLOWER, SimConfig
+from madraft_tpu.tpusim.config import (
+    FOLLOWER,
+    NOOP_CMD,
+    SimConfig,
+    packed_bounds,
+)
 
 I32 = jnp.int32
 BOOL = jnp.bool_
+U8 = jnp.uint8
+U16 = jnp.uint16
+U32 = jnp.uint32
+
+# Trace/replay artifact schema version (MIGRATION.md "State layout"):
+#   1 — the wide layout: every ClusterState field i32 (or padded bool)
+#   2 — the packed cold-state schema below (PackedClusterState): narrow
+#       dtypes derived from config.packed_bounds, bitfield words for
+#       role/alive/adjacency/votes, tick-relative u8 mailbox stamps.
+# Replay/explain JSON carries this plus the layout the run actually used.
+STATE_SCHEMA_VERSION = 2
 
 
 class ClusterState(NamedTuple):
@@ -239,3 +257,366 @@ def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
         msg_count=jnp.asarray(0, I32),
         snap_install_count=jnp.asarray(0, I32),
     )
+
+
+# ---------------------------------------------------------------------------
+# Packed cold-state schema (ISSUE 9; ROADMAP item 5).
+#
+# The per-tick arithmetic above runs on i32 arrays — the wide layout. The
+# CARRIED state (the fori_loop/scan carry of the chunk, pool, trace, and
+# replay programs — what actually sits in HBM between ticks and between
+# dispatches, double-buffered under donation) is this packed schema: every
+# field narrowed to the smallest dtype its configured range admits
+# (config.packed_bounds is the single source of those ranges), with
+# widen-on-use at the step boundary (step.step_cluster_packed = pack o step
+# o unpack), so the tick itself never touches a narrow dtype.
+#
+# Encodings beyond the plain casts:
+#   role_bits / alive_bits  all nodes' role (2 bits each) / aliveness (1 bit)
+#                           in ONE u32 word per cluster (n_nodes <= 16)
+#   *_bits rows             [n, n] bool matrices (votes, adj, rv granted,
+#                           ae success) as [n] u32 row bitmasks — bit j of
+#                           row i = mat[i, j], the trace.TickRecord adj_mask
+#                           convention
+#   *_rel stamps            mailbox delivery ticks stored RELATIVE to the
+#                           cluster tick in one u8 (0 = empty slot): every
+#                           live slot holds a future tick and the per-send
+#                           delay is < 256 (_net_draws), so stamp - tick in
+#                           [1, 254] — see packed_layout_reason's delay gate
+#   log_val / shadow_val    cmd payloads in the cmd-bound dtype, with
+#                           NOOP_CMD (1 << 30, far outside any packed range)
+#                           re-encoded as the dtype's reserved max value
+#   voted_for / *_src       node ids incl. the -1 sentinel: plain i8
+#
+# Round-trip exactness (unpack_state(pack_state(s)) == s bit-for-bit, for
+# every state whose values respect the configured bounds) is the load-
+# bearing property — it is what keeps the golden fuzz/pool guards and the
+# (seed, cluster_id) replay contract bit-identical on the packed path —
+# and tests/test_state_layout.py pins it on randomized boundary-value
+# states and on real trajectories.
+# ---------------------------------------------------------------------------
+
+
+class PackedSpec(NamedTuple):
+    """Derived dtypes of the packed schema for one SimConfig (the widths
+    tests pin against config.packed_bounds)."""
+
+    tick: object        # dtype of tick/next_cmd (bound: packed_bounds.tick)
+    term: object        # dtype of every term-valued field
+    index: object       # dtype of every absolute log-index field
+    cmd: object         # dtype of log_val/shadow_val payloads
+    noop_code: int      # the cmd dtype's reserved encoding of NOOP_CMD
+    tick_signed: object  # first_violation_tick / first_leader_tick (-1 ok)
+
+
+def _uint_for(bound: int):
+    """Smallest unsigned dtype holding [0, bound]."""
+    for dt in (U8, U16, U32):
+        if bound <= np.iinfo(dt).max:
+            return dt
+    raise ValueError(f"packed bound {bound} exceeds u32")
+
+
+def _sint_for(bound: int):
+    """Smallest signed dtype holding [-1, bound]."""
+    for dt in (jnp.int8, jnp.int16, I32):
+        if bound <= np.iinfo(dt).max:
+            return dt
+    raise ValueError(f"packed bound {bound} exceeds i32")
+
+
+@functools.lru_cache(maxsize=None)
+def packed_spec(cfg: SimConfig) -> PackedSpec:
+    b = packed_bounds(cfg)
+    cmd_dt = _uint_for(b.cmd + 1)  # + 1 reserves a distinct NOOP sentinel
+    return PackedSpec(
+        tick=_uint_for(b.tick),
+        term=_uint_for(b.term),
+        index=_uint_for(b.index),
+        cmd=cmd_dt,
+        noop_code=int(np.iinfo(cmd_dt).max),
+        tick_signed=_sint_for(b.tick),
+    )
+
+
+class PackedClusterState(NamedTuple):
+    """ClusterState in the packed schema (field order mirrors the wide
+    form; `_bits` = bitfield word(s), `_rel` = tick-relative u8 stamp)."""
+
+    tick: jax.Array
+    term: jax.Array
+    voted_for: jax.Array        # i8, -1 sentinel intact
+    role_bits: jax.Array        # u32 scalar: 2 bits per node
+    timer: jax.Array            # u16 (eto_max gated by packed_layout_reason)
+    hb: jax.Array               # u16
+    alive_bits: jax.Array       # u32 scalar bitfield
+    log_term: jax.Array
+    log_val: jax.Array          # cmd dtype; NOOP_CMD -> noop_code
+    log_len: jax.Array
+    base: jax.Array
+    snap_term: jax.Array
+    prefix_hash: jax.Array      # i32 — a full 32-bit hash stays wide
+    commit: jax.Array
+    durable_len: jax.Array
+    durable_term: jax.Array
+    durable_voted_for: jax.Array  # i8
+    compact_floor: jax.Array
+    votes_bits: jax.Array       # u32 [n] row masks
+    next_idx: jax.Array
+    match_idx: jax.Array
+    adj_bits: jax.Array         # u32 [n] row masks
+    rv_req_rel: jax.Array
+    rv_req_term: jax.Array
+    rv_req_lli: jax.Array
+    rv_req_llt: jax.Array
+    rv_rsp_rel: jax.Array
+    rv_rsp_term: jax.Array
+    rv_rsp_granted_bits: jax.Array  # u32 [n]
+    ae_req_rel: jax.Array
+    ae_req_term: jax.Array
+    ae_req_prev: jax.Array
+    ae_req_prev_term: jax.Array
+    ae_req_n: jax.Array         # u8 (<= ae_max)
+    ae_req_commit: jax.Array
+    ae_rsp_rel: jax.Array
+    ae_rsp_term: jax.Array
+    ae_rsp_success_bits: jax.Array  # u32 [n]
+    ae_rsp_match: jax.Array
+    sn_req_rel: jax.Array
+    sn_req_term: jax.Array
+    snap_installed_src: jax.Array   # i8, -1 sentinel intact
+    snap_installed_len: jax.Array
+    next_cmd: jax.Array
+    shadow_term: jax.Array
+    shadow_val: jax.Array       # cmd dtype; NOOP_CMD -> noop_code
+    shadow_base: jax.Array
+    shadow_len: jax.Array
+    shadow_prefix_hash: jax.Array   # i32
+    violations: jax.Array           # i32 — shared across service layers
+    first_violation_tick: jax.Array  # tick_signed
+    first_leader_tick: jax.Array     # tick_signed
+    msg_count: jax.Array            # i32 cumulative counter
+    snap_install_count: jax.Array   # i32
+
+
+def _bit_weights(n: int) -> jax.Array:
+    return jnp.left_shift(jnp.asarray(1, U32), jnp.arange(n, dtype=U32))
+
+
+def _pack_bool_rows(mat: jax.Array) -> jax.Array:
+    """[n, n] bool -> [n] u32 row masks (bit j of row i = mat[i, j])."""
+    n = mat.shape[-1]
+    return jnp.sum(
+        jnp.where(mat, _bit_weights(n)[None, :], jnp.asarray(0, U32)),
+        axis=-1, dtype=U32,
+    )
+
+
+def _unpack_bool_rows(rows: jax.Array, n: int) -> jax.Array:
+    return (
+        (rows[:, None] >> jnp.arange(n, dtype=U32)[None, :]) & 1
+    ).astype(BOOL)
+
+
+def pack_state(cfg: SimConfig, s: ClusterState) -> PackedClusterState:
+    """Wide -> packed, exact for every value within config.packed_bounds.
+    Written per-cluster; the engine vmaps it over the lane axis."""
+    sp = packed_spec(cfg)
+    n = cfg.n_nodes
+    t = s.tick
+    idx = jnp.arange(n, dtype=U32)
+
+    def rel(stamp):  # live stamps are strictly in the future (> tick)
+        return jnp.where(stamp > 0, stamp - t, 0).astype(U8)
+
+    noop = jnp.asarray(sp.noop_code, sp.cmd)
+
+    def cmd(v):
+        return jnp.where(v == NOOP_CMD, noop, v.astype(sp.cmd))
+
+    return PackedClusterState(
+        tick=s.tick.astype(sp.tick),
+        term=s.term.astype(sp.term),
+        voted_for=s.voted_for.astype(jnp.int8),
+        role_bits=jnp.sum(s.role.astype(U32) << (2 * idx), dtype=U32),
+        timer=s.timer.astype(U16),
+        hb=s.hb.astype(U16),
+        alive_bits=jnp.sum(
+            jnp.where(s.alive, _bit_weights(n), jnp.asarray(0, U32)),
+            dtype=U32,
+        ),
+        log_term=s.log_term.astype(sp.term),
+        log_val=cmd(s.log_val),
+        log_len=s.log_len.astype(sp.index),
+        base=s.base.astype(sp.index),
+        snap_term=s.snap_term.astype(sp.term),
+        prefix_hash=s.prefix_hash,
+        commit=s.commit.astype(sp.index),
+        durable_len=s.durable_len.astype(sp.index),
+        durable_term=s.durable_term.astype(sp.term),
+        durable_voted_for=s.durable_voted_for.astype(jnp.int8),
+        compact_floor=s.compact_floor.astype(sp.index),
+        votes_bits=_pack_bool_rows(s.votes),
+        next_idx=s.next_idx.astype(sp.index),
+        match_idx=s.match_idx.astype(sp.index),
+        adj_bits=_pack_bool_rows(s.adj),
+        rv_req_rel=rel(s.rv_req_t),
+        rv_req_term=s.rv_req_term.astype(sp.term),
+        rv_req_lli=s.rv_req_lli.astype(sp.index),
+        rv_req_llt=s.rv_req_llt.astype(sp.term),
+        rv_rsp_rel=rel(s.rv_rsp_t),
+        rv_rsp_term=s.rv_rsp_term.astype(sp.term),
+        rv_rsp_granted_bits=_pack_bool_rows(s.rv_rsp_granted),
+        ae_req_rel=rel(s.ae_req_t),
+        ae_req_term=s.ae_req_term.astype(sp.term),
+        ae_req_prev=s.ae_req_prev.astype(sp.index),
+        ae_req_prev_term=s.ae_req_prev_term.astype(sp.term),
+        ae_req_n=s.ae_req_n.astype(U8),
+        ae_req_commit=s.ae_req_commit.astype(sp.index),
+        ae_rsp_rel=rel(s.ae_rsp_t),
+        ae_rsp_term=s.ae_rsp_term.astype(sp.term),
+        ae_rsp_success_bits=_pack_bool_rows(s.ae_rsp_success),
+        ae_rsp_match=s.ae_rsp_match.astype(sp.index),
+        sn_req_rel=rel(s.sn_req_t),
+        sn_req_term=s.sn_req_term.astype(sp.term),
+        snap_installed_src=s.snap_installed_src.astype(jnp.int8),
+        snap_installed_len=s.snap_installed_len.astype(sp.index),
+        next_cmd=s.next_cmd.astype(sp.tick),
+        shadow_term=s.shadow_term.astype(sp.term),
+        shadow_val=cmd(s.shadow_val),
+        shadow_base=s.shadow_base.astype(sp.index),
+        shadow_len=s.shadow_len.astype(sp.index),
+        shadow_prefix_hash=s.shadow_prefix_hash,
+        violations=s.violations,
+        first_violation_tick=s.first_violation_tick.astype(sp.tick_signed),
+        first_leader_tick=s.first_leader_tick.astype(sp.tick_signed),
+        msg_count=s.msg_count,
+        snap_install_count=s.snap_install_count,
+    )
+
+
+def unpack_state(cfg: SimConfig, p: PackedClusterState) -> ClusterState:
+    """Packed -> wide (the widen-on-use boundary): exact inverse of
+    pack_state, restoring the i32/bool dtypes step_cluster runs on."""
+    sp = packed_spec(cfg)
+    n = cfg.n_nodes
+    t = p.tick.astype(I32)
+    idx = jnp.arange(n, dtype=U32)
+
+    def stamp(r):
+        r32 = r.astype(I32)
+        return jnp.where(r32 > 0, t + r32, 0)
+
+    noop = jnp.asarray(sp.noop_code, sp.cmd)
+
+    def cmd(v):
+        return jnp.where(v == noop, NOOP_CMD, v.astype(I32))
+
+    return ClusterState(
+        tick=t,
+        term=p.term.astype(I32),
+        voted_for=p.voted_for.astype(I32),
+        role=((p.role_bits >> (2 * idx)) & 3).astype(I32),
+        timer=p.timer.astype(I32),
+        hb=p.hb.astype(I32),
+        alive=((p.alive_bits >> idx) & 1).astype(BOOL),
+        log_term=p.log_term.astype(I32),
+        log_val=cmd(p.log_val),
+        log_len=p.log_len.astype(I32),
+        base=p.base.astype(I32),
+        snap_term=p.snap_term.astype(I32),
+        prefix_hash=p.prefix_hash,
+        commit=p.commit.astype(I32),
+        durable_len=p.durable_len.astype(I32),
+        durable_term=p.durable_term.astype(I32),
+        durable_voted_for=p.durable_voted_for.astype(I32),
+        compact_floor=p.compact_floor.astype(I32),
+        votes=_unpack_bool_rows(p.votes_bits, n),
+        next_idx=p.next_idx.astype(I32),
+        match_idx=p.match_idx.astype(I32),
+        adj=_unpack_bool_rows(p.adj_bits, n),
+        rv_req_t=stamp(p.rv_req_rel),
+        rv_req_term=p.rv_req_term.astype(I32),
+        rv_req_lli=p.rv_req_lli.astype(I32),
+        rv_req_llt=p.rv_req_llt.astype(I32),
+        rv_rsp_t=stamp(p.rv_rsp_rel),
+        rv_rsp_term=p.rv_rsp_term.astype(I32),
+        rv_rsp_granted=_unpack_bool_rows(p.rv_rsp_granted_bits, n),
+        ae_req_t=stamp(p.ae_req_rel),
+        ae_req_term=p.ae_req_term.astype(I32),
+        ae_req_prev=p.ae_req_prev.astype(I32),
+        ae_req_prev_term=p.ae_req_prev_term.astype(I32),
+        ae_req_n=p.ae_req_n.astype(I32),
+        ae_req_commit=p.ae_req_commit.astype(I32),
+        ae_rsp_t=stamp(p.ae_rsp_rel),
+        ae_rsp_term=p.ae_rsp_term.astype(I32),
+        ae_rsp_success=_unpack_bool_rows(p.ae_rsp_success_bits, n),
+        ae_rsp_match=p.ae_rsp_match.astype(I32),
+        sn_req_t=stamp(p.sn_req_rel),
+        sn_req_term=p.sn_req_term.astype(I32),
+        snap_installed_src=p.snap_installed_src.astype(I32),
+        snap_installed_len=p.snap_installed_len.astype(I32),
+        next_cmd=p.next_cmd.astype(I32),
+        shadow_term=p.shadow_term.astype(I32),
+        shadow_val=cmd(p.shadow_val),
+        shadow_base=p.shadow_base.astype(I32),
+        shadow_len=p.shadow_len.astype(I32),
+        shadow_prefix_hash=p.shadow_prefix_hash,
+        violations=p.violations,
+        first_violation_tick=p.first_violation_tick.astype(I32),
+        first_leader_tick=p.first_leader_tick.astype(I32),
+        msg_count=p.msg_count,
+        snap_install_count=p.snap_install_count,
+    )
+
+
+def packed_layout_reason(cfg: SimConfig, kn, ticks_needed: int) -> Optional[str]:
+    """None when the packed schema is EXACT for a run of up to
+    ``ticks_needed`` per-lane ticks under knob values ``kn`` — else a
+    human-readable reason the engine falls back to the wide layout (and
+    reports it as ``state_layout: "wide"``).
+
+    ``kn`` must be concrete (every entry point builds knobs from Python
+    values before compiling). The coverage pool's refill mutates only the
+    [0, 1] probability knobs (coverage.MUTABLE_KNOBS), so a gate passed at
+    entry cannot be invalidated by mutation mid-run.
+    """
+    if cfg.n_nodes > 16:
+        return (
+            f"n_nodes {cfg.n_nodes} > 16: role pairs (2 bits/node) and "
+            "adjacency/vote row masks must fit one u32 word"
+        )
+    if cfg.ae_max > np.iinfo(np.uint8).max:
+        return f"ae_max {cfg.ae_max} exceeds the u8 ae_req_n field"
+    if ticks_needed > cfg.max_lane_ticks:
+        return (
+            f"run needs {ticks_needed} per-lane ticks > max_lane_ticks "
+            f"{cfg.max_lane_ticks} (raise SimConfig.max_lane_ticks to pack "
+            "longer horizons; widths re-derive automatically)"
+        )
+    k = jax.tree.map(np.asarray, kn)
+    b = packed_bounds(cfg)
+    if (k.delay_max > b.rel_stamp - 1).any():
+        return (
+            f"delay_max {k.delay_max} > {b.rel_stamp - 1}: mailbox stamps "
+            "are stored tick-relative in one u8 (0 = empty)"
+        )
+    if (k.delay_min < 1).any():
+        # a zero-delay send stamps the CURRENT tick, which the relative
+        # encoding cannot distinguish from an empty slot (rel 0) — and the
+        # pool entry points do not route through _validate_knobs, so the
+        # exactness gate must reject it here
+        return f"delay_min {k.delay_min} < 1: a same-tick stamp would " \
+               "pack as an empty mailbox slot"
+    if (k.eto_max > np.iinfo(np.uint16).max).any():
+        return f"eto_max {k.eto_max} exceeds the u16 timer field"
+    if (k.heartbeat_ticks > np.iinfo(np.uint16).max).any():
+        return f"heartbeat_ticks {k.heartbeat_ticks} exceeds the u16 field"
+    return None
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of every array leaf — the live-buffer footprint
+    measurement behind the ``state_hbm_bytes``/``bytes_per_lane`` summary
+    telemetry (actual buffer sizes, never a schema estimate)."""
+    return int(sum(x.nbytes for x in jax.tree.leaves(tree)))

@@ -79,9 +79,27 @@ from madraft_tpu.tpusim.config import (
     VIOLATION_LOG_MATCHING,
     VIOLATION_PREFIX_DIVERGE,
 )
-from madraft_tpu.tpusim.state import ClusterState, I32
+from madraft_tpu.tpusim.state import (
+    ClusterState,
+    I32,
+    PackedClusterState,
+    pack_state,
+    unpack_state,
+)
 
 _BIG = 1 << 30  # sentinel above any absolute log index
+
+
+def step_cluster_packed(
+    cfg: SimConfig, p: PackedClusterState, cluster_key: jax.Array, kn=None
+) -> PackedClusterState:
+    """One tick over the PACKED carry (ISSUE 9): widen-on-use at this
+    boundary — unpack to the wide i32 layout, run the identical
+    step_cluster, pack the result. The arithmetic below never sees a
+    narrow dtype, so the trajectory is bit-identical to the wide carry
+    whenever pack/unpack round-trips exactly (state.py packed schema
+    notes); only what the loop CARRIES — the HBM-resident share — shrinks."""
+    return pack_state(cfg, step_cluster(cfg, unpack_state(cfg, p), cluster_key, kn))
 
 # Raft-tick PRNG block id (kv.py/shardkv.py fold their own disjoint ids).
 _S_STEP_BLOCK = 0

@@ -43,7 +43,14 @@ from madraft_tpu.tpusim.config import (
     SimConfig,
     violation_names,
 )
-from madraft_tpu.tpusim.state import ClusterState, I32, init_cluster
+from madraft_tpu.tpusim.state import (
+    ClusterState,
+    I32,
+    init_cluster,
+    pack_state,
+    packed_layout_reason,
+    unpack_state,
+)
 from madraft_tpu.tpusim.step import step_cluster
 
 ROLE_NAMES = ("follower", "candidate", "leader")
@@ -127,20 +134,34 @@ def _record(prev: ClusterState, nxt: ClusterState) -> TickRecord:
 
 
 @functools.lru_cache(maxsize=None)
-def _traced_program(static_cfg: SimConfig, n_ticks: int):
+def _traced_program(static_cfg: SimConfig, n_ticks: int,
+                    packed: bool = False):
     """One compiled traced-replay program per (static shape, tick count).
     The scan length must be static (it shapes the stacked outputs), so
-    n_ticks joins the cache key — fine for single-cluster replay."""
+    n_ticks joins the cache key — fine for single-cluster replay. With
+    ``packed`` the scan CARRY is the packed schema the pool/chunk programs
+    use (ISSUE 9: trace shares the one state layout) and each tick widens
+    on use; the TickRecord is computed from the wide views, so the trace —
+    like the final state — is bit-identical across layouts."""
 
     def run(cluster_id, kn, seed):
         ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
         state0 = init_cluster(static_cfg, ckey, kn)
+        if packed:
+            state0 = pack_state(static_cfg, state0)
 
         def body(carry, _):
-            nxt = step_cluster(static_cfg, carry, ckey, kn)
-            return nxt, _record(carry, nxt)
+            prev = unpack_state(static_cfg, carry) if packed else carry
+            nxt = step_cluster(static_cfg, prev, ckey, kn)
+            return (
+                pack_state(static_cfg, nxt) if packed else nxt,
+                _record(prev, nxt),
+            )
 
-        return jax.lax.scan(body, state0, None, length=n_ticks)
+        final, rec = jax.lax.scan(body, state0, None, length=n_ticks)
+        if packed:
+            final = unpack_state(static_cfg, final)
+        return final, rec
 
     return jax.jit(run)
 
@@ -162,9 +183,12 @@ def replay_cluster_traced(
     """
     from madraft_tpu.tpusim.engine import resolve_knobs
 
-    prog = _traced_program(cfg.static_key(), int(n_ticks))
+    kn = resolve_knobs(cfg, knobs)
+    # same layout rule as replay_cluster/run_pool: packed when exact
+    packed = packed_layout_reason(cfg, kn, int(n_ticks)) is None
+    prog = _traced_program(cfg.static_key(), int(n_ticks), packed)
     final, rec = jax.block_until_ready(
-        prog(jnp.asarray(cluster_id, I32), resolve_knobs(cfg, knobs),
+        prog(jnp.asarray(cluster_id, I32), kn,
              jnp.asarray(seed, jnp.uint32))
     )
     return final, jax.tree.map(np.asarray, rec)

@@ -1,0 +1,335 @@
+"""The packed cold-state schema (ISSUE 9): pack -> widen round-trip
+bit-identity on randomized boundary-value ClusterStates across EVERY field,
+derived widths pinned against config.packed_bounds (overflow at a configured
+max is a test failure here, never silent wraparound at run time), layout-
+blind trajectories (pool/replay/coverage bit-identical packed vs wide), the
+packed fingerprint, the footprint telemetry, and the wide fallback gates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim import state as st
+from madraft_tpu.tpusim.config import NOOP_CMD, packed_bounds
+from madraft_tpu.tpusim.engine import replay_cluster, run_pool
+
+STORM = SimConfig(
+    n_nodes=5, p_client_cmd=0.2, loss_prob=0.1, p_crash=0.01, p_restart=0.2,
+    max_dead=2, p_repartition=0.02, p_heal=0.05,
+)
+VIOL = STORM.replace(majority_override=2)
+
+
+def _rand_state(cfg: SimConfig, rng: np.random.Generator,
+                boundary: bool = False) -> st.ClusterState:
+    """A random wide ClusterState whose every field spans its CONFIGURED
+    packed range — incl. the -1 sentinels, NOOP_CMD payloads, and (with
+    ``boundary``) every bound's exact maximum, so the round-trip test fails
+    loudly the day a width stops holding its declared bound."""
+    n, cap = cfg.n_nodes, cfg.log_cap
+    b = packed_bounds(cfg)
+    i32 = lambda x: jnp.asarray(x, jnp.int32)  # noqa: E731
+
+    def ints(hi, shape=(), lo=0):
+        if boundary:
+            return i32(np.full(shape, hi, np.int64))
+        return i32(rng.integers(lo, hi + 1, size=shape))
+
+    def bools(shape):
+        val = True if boundary else rng.integers(0, 2, size=shape).astype(bool)
+        return jnp.asarray(np.broadcast_to(val, shape), jnp.bool_)
+
+    tick = int(ints(b.tick))
+
+    def stamp(shape):
+        # a live mailbox slot is strictly in the future, within the u8 span
+        rel = rng.integers(0, b.rel_stamp + 1, size=shape)
+        if boundary:
+            rel = np.full(shape, b.rel_stamp)
+        return i32(np.where(rel > 0, tick + rel, 0))
+
+    def cmds(shape):
+        v = rng.integers(0, b.cmd + 1, size=shape)
+        v = np.where(rng.random(shape) < 0.1, NOOP_CMD, v)
+        if boundary:
+            v = np.full(shape, b.cmd)
+        return i32(v)
+
+    def node_id(shape):
+        return ints(n - 1, shape, lo=-1)
+
+    def neg1_tick(shape):
+        v = rng.integers(-1, b.tick + 1, size=shape)
+        return i32(np.full(shape, b.tick) if boundary else v)
+
+    return st.ClusterState(
+        tick=i32(tick),
+        term=ints(b.term, (n,)),
+        voted_for=node_id((n,)),
+        role=ints(2, (n,)),
+        timer=ints(np.iinfo(np.uint16).max, (n,)),
+        hb=ints(np.iinfo(np.uint16).max, (n,)),
+        alive=bools((n,)),
+        log_term=ints(b.term, (n, cap)),
+        log_val=cmds((n, cap)),
+        log_len=ints(b.index, (n,)),
+        base=ints(b.index, (n,)),
+        snap_term=ints(b.term, (n,)),
+        prefix_hash=i32(rng.integers(-(2**31), 2**31, size=(n,))),
+        commit=ints(b.index, (n,)),
+        durable_len=ints(b.index, (n,)),
+        durable_term=ints(b.term, (n,)),
+        durable_voted_for=node_id((n,)),
+        compact_floor=ints(b.index, (n,)),
+        votes=bools((n, n)),
+        next_idx=ints(b.index, (n, n)),
+        match_idx=ints(b.index, (n, n)),
+        adj=bools((n, n)),
+        rv_req_t=stamp((n, n)),
+        rv_req_term=ints(b.term, (n, n)),
+        rv_req_lli=ints(b.index, (n, n)),
+        rv_req_llt=ints(b.term, (n, n)),
+        rv_rsp_t=stamp((n, n)),
+        rv_rsp_term=ints(b.term, (n, n)),
+        rv_rsp_granted=bools((n, n)),
+        ae_req_t=stamp((n, n)),
+        ae_req_term=ints(b.term, (n, n)),
+        ae_req_prev=ints(b.index, (n, n)),
+        ae_req_prev_term=ints(b.term, (n, n)),
+        ae_req_n=ints(cfg.ae_max, (n, n)),
+        ae_req_commit=ints(b.index, (n, n)),
+        ae_rsp_t=stamp((n, n)),
+        ae_rsp_term=ints(b.term, (n, n)),
+        ae_rsp_success=bools((n, n)),
+        ae_rsp_match=ints(b.index, (n, n)),
+        sn_req_t=stamp((n, n)),
+        sn_req_term=ints(b.term, (n, n)),
+        snap_installed_src=node_id((n,)),
+        snap_installed_len=ints(b.index, (n,)),
+        next_cmd=ints(b.tick),
+        shadow_term=ints(b.term, (cap,)),
+        shadow_val=cmds((cap,)),
+        shadow_base=ints(b.index),
+        shadow_len=ints(b.index),
+        shadow_prefix_hash=i32(int(rng.integers(-(2**31), 2**31))),
+        violations=i32(int(rng.integers(0, 1 << 16))),
+        first_violation_tick=neg1_tick(()),
+        first_leader_tick=neg1_tick(()),
+        msg_count=i32(int(rng.integers(0, 2**31))),
+        snap_install_count=i32(int(rng.integers(0, 2**31))),
+    )
+
+
+def _assert_states_equal(a: st.ClusterState, b: st.ClusterState):
+    for f in a._fields:
+        x, y = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert x.dtype == y.dtype, (f, x.dtype, y.dtype)
+        np.testing.assert_array_equal(x, y, err_msg=f"round-trip drift in {f}")
+
+
+@pytest.mark.parametrize("cfg", [
+    STORM,
+    SimConfig(n_nodes=7, log_cap=32, compact_every=8),  # revote-ish shape
+    SimConfig(n_nodes=3, log_cap=16, ae_max=2, compact_every=4),
+    SimConfig(n_nodes=16, log_cap=16, compact_every=4),  # widest word
+    SimConfig(max_lane_ticks=1 << 18),                # u32-index regime
+])
+def test_pack_roundtrip_randomized_every_field(cfg):
+    rng = np.random.default_rng(7)
+    for i in range(4):
+        s = _rand_state(cfg, rng)
+        _assert_states_equal(s, st.unpack_state(cfg, st.pack_state(cfg, s)))
+    # every bound's exact max must survive (the overflow-is-a-test-failure
+    # satellite): term/log index/cmd/stamp/timer all at their ceilings
+    s = _rand_state(cfg, rng, boundary=True)
+    _assert_states_equal(s, st.unpack_state(cfg, st.pack_state(cfg, s)))
+
+
+def test_widths_pin_to_config_bounds():
+    # the derivation chain config.packed_bounds -> state.packed_spec is the
+    # single source of widths: each dtype must hold its bound (incl. the
+    # reserved NOOP sentinel strictly above the cmd bound), and bumping a
+    # bound must WIDEN the dtype rather than silently wrap
+    for cfg in (STORM, SimConfig(n_nodes=16), SimConfig(max_lane_ticks=1 << 18)):
+        b = packed_bounds(cfg)
+        sp = st.packed_spec(cfg)
+        assert np.iinfo(sp.tick).max >= b.tick
+        assert np.iinfo(sp.term).max >= b.term
+        assert np.iinfo(sp.index).max >= b.index
+        assert np.iinfo(sp.cmd).max >= b.cmd + 1
+        assert sp.noop_code > b.cmd, "NOOP sentinel must sit above any cmd"
+        assert np.iinfo(sp.tick_signed).max >= b.tick  # -1 sentinel fields
+        assert b.rel_stamp <= np.iinfo(np.uint8).max - 1
+    # defaults: 5 nodes / 4096 ticks fit u16 everywhere
+    sp = st.packed_spec(STORM.static_key())
+    assert sp.term == jnp.uint16 and sp.index == jnp.uint16
+    assert sp.cmd == jnp.uint16
+    # 16 nodes push the cmd bound past u16 -> the width derives up
+    assert st.packed_spec(SimConfig(n_nodes=16).static_key()) .cmd == jnp.uint32
+    # a longer declared horizon widens index/cmd, not a runtime surprise
+    assert st.packed_spec(
+        SimConfig(max_lane_ticks=1 << 18).static_key()
+    ).index == jnp.uint32
+    with pytest.raises(ValueError, match="max_lane_ticks"):
+        SimConfig(max_lane_ticks=1 << 25)
+
+
+def test_real_trajectory_roundtrip_batched():
+    # not just synthetic states: a real 128-tick storm batch round-trips
+    # bit-identically through the vmapped pack/unpack
+    from madraft_tpu.tpusim.engine import make_fuzz_fn
+
+    final = jax.block_until_ready(make_fuzz_fn(STORM, 16, 128)(3))
+    packed = jax.vmap(lambda s: st.pack_state(STORM, s))(final)
+    back = jax.vmap(lambda p: st.unpack_state(STORM, p))(packed)
+    _assert_states_equal(final, back)
+
+
+def _strip(rows):
+    return [
+        {k: v for k, v in r.items() if k not in ("wall_s", "violations_per_s")}
+        for r in rows
+    ]
+
+_DET_SUMMARY = (
+    "lanes", "horizon", "chunk_ticks", "lane_ticks", "ticks_dispatched",
+    "retired", "retired_violating", "violating_clusters",
+    "violating_clusters_total", "violation_names", "effective_cluster_steps",
+)
+
+
+def test_pool_reports_bit_identical_across_layouts():
+    # THE golden-guard property of the refactor: the packed carry changes
+    # where bytes live, never what the pool reports
+    def leg(pack):
+        rows = []
+        s = run_pool(VIOL, 7, 16, 64, chunk_ticks=32, budget_ticks=320,
+                     on_retired=rows.append, pack_states=pack)
+        return rows, s
+
+    rows_w, s_w = leg(False)
+    rows_p, s_p = leg(True)
+    assert s_w["state_layout"] == "wide" and s_p["state_layout"] == "packed"
+    assert _strip(rows_p) == _strip(rows_w)
+    for k in _DET_SUMMARY:
+        assert s_p[k] == s_w[k], (k, s_p[k], s_w[k])
+    # the point of the exercise: the resident carry shrank >= 2x, and the
+    # reported footprint is the live-buffer measurement, not an estimate
+    assert s_w["bytes_per_lane"] >= 2 * s_p["bytes_per_lane"]
+    assert s_p["state_hbm_bytes"] == s_p["bytes_per_lane"] * 16
+
+
+def test_coverage_pool_bit_identical_across_layouts():
+    # guided coverage (mutated knob rows, per-lane layout, fingerprints)
+    # is layout-blind too — including the knobs columns mutated refills
+    # carry for replay
+    from madraft_tpu.tpusim.config import CoverageConfig
+
+    ccfg = CoverageConfig(bitmap_bits=1 << 12)
+
+    def leg(pack):
+        rows = []
+        s = run_pool(VIOL, 7, 16, 64, chunk_ticks=32, budget_ticks=320,
+                     coverage=ccfg, on_retired=rows.append, pack_states=pack)
+        return rows, s
+
+    rows_w, s_w = leg(False)
+    rows_p, s_p = leg(True)
+    assert _strip(rows_p) == _strip(rows_w)
+    det_cov = lambda s: {k: v for k, v in s["coverage"].items()  # noqa: E731
+                         if k != "new_fingerprints_per_s"}  # wall-derived
+    assert det_cov(s_p) == det_cov(s_w)
+    for k in _DET_SUMMARY:
+        assert s_p[k] == s_w[k], (k, s_p[k], s_w[k])
+
+
+def test_abstract_code_packed_matches_wide():
+    from madraft_tpu.tpusim import coverage as cov
+    from madraft_tpu.tpusim.config import CoverageConfig
+
+    rng = np.random.default_rng(11)
+    for ccfg in (CoverageConfig(), CoverageConfig(term_rank_levels=2,
+                                                  commit_delta_levels=2)):
+        for _ in range(8):
+            s = _rand_state(STORM, rng)
+            a = cov.abstract_code(ccfg, s)
+            b = cov.abstract_code_packed(ccfg, st.pack_state(STORM, s))
+            assert int(a) == int(b)
+
+
+def test_replay_bit_identical_across_layouts():
+    # the same (seed, cluster_id) through the packed replay carry vs a
+    # config whose declared ceiling forces the wide fallback — trajectories
+    # must agree field by field (the replay-contract half of the guard)
+    assert st.packed_layout_reason(VIOL, VIOL.knobs(), 96) is None
+    narrow = VIOL.replace(max_lane_ticks=8)  # 96 ticks > 8 -> wide layout
+    assert st.packed_layout_reason(narrow, narrow.knobs(), 96) is not None
+    a = replay_cluster(VIOL, 7, 3, 96)
+    b = replay_cluster(narrow, 7, 3, 96)
+    _assert_states_equal(a, b)
+
+
+def test_traced_replay_matches_untraced_on_packed_layout():
+    from madraft_tpu.tpusim.trace import replay_cluster_traced
+
+    final, rec = replay_cluster_traced(VIOL, 7, 3, 96)
+    untraced = replay_cluster(VIOL, 7, 3, 96)
+    _assert_states_equal(final, untraced)
+    assert rec.role.shape[0] == 96
+
+
+def test_wide_fallback_reasons_and_forced_pack_rejection():
+    kn = STORM.knobs()
+    # each gate names its reason
+    assert "max_lane_ticks" in st.packed_layout_reason(STORM, kn, 10**6)
+    assert "n_nodes" in st.packed_layout_reason(
+        SimConfig(n_nodes=17), SimConfig(n_nodes=17).knobs(), 10)
+    wide_delay = STORM.replace(delay_max=300)
+    assert "delay_max" in st.packed_layout_reason(
+        wide_delay, wide_delay.knobs(), 10)
+    # ae_req_n is a fixed u8: an ae_max past it must fall back, not wrap
+    big_ae = SimConfig(ae_max=300, log_cap=1024)
+    assert "ae_max" in st.packed_layout_reason(big_ae, big_ae.knobs(), 10)
+    # a zero-delay send stamps the CURRENT tick — indistinguishable from an
+    # empty slot under the relative encoding, so the gate must reject it
+    # (the pool path never runs _validate_knobs)
+    zero_delay = STORM.replace(delay_min=0)
+    assert "delay_min" in st.packed_layout_reason(
+        zero_delay, zero_delay.knobs(), 10)
+    # auto mode falls back (and says so); forcing the pack refuses loudly
+    s = run_pool(wide_delay, 3, 8, 32, chunk_ticks=32, budget_ticks=32)
+    assert s["state_layout"] == "wide"
+    with pytest.raises(ValueError, match="packed layout is not exact"):
+        run_pool(wide_delay, 3, 8, 32, chunk_ticks=32, budget_ticks=32,
+                 pack_states=True)
+
+
+def test_packed_chunk_carry_is_donated():
+    # the packed pool keeps the PR-3 double-buffer discipline: the packed
+    # chunk consumes its carry, so peak HBM is the packed footprint x2,
+    # not packed + wide
+    from madraft_tpu.tpusim.engine import _chunk_program, _pool_init_program
+
+    static = STORM.static_key()
+    kn = STORM.knobs()
+    init = _pool_init_program(static, 16, None, True)
+    chunk = _chunk_program(static, 16, True)
+    states, keys, _ = init(jnp.asarray(3, jnp.uint32), kn,
+                           jnp.asarray(0, jnp.int32))
+    out = chunk(states, keys, kn, jnp.asarray(8, jnp.int32))
+    assert int(np.asarray(out.tick)[0]) == 8
+    with pytest.raises(Exception, match="[Dd]onat|[Dd]elet"):
+        np.asarray(states.tick)
+
+
+def test_footprint_reduction_at_least_2x_on_storm_shape():
+    # the PERF.md round-9 headline, pinned as a regression bound from the
+    # LIVE buffers (ci.sh additionally bounds the absolute bytes_per_lane
+    # so a later PR cannot silently re-widen a field)
+    key = jax.random.PRNGKey(0)
+    s = st.init_cluster(STORM, key)
+    wide = st.tree_bytes(s)
+    packed = st.tree_bytes(st.pack_state(STORM, s))
+    assert wide >= 2 * packed, (wide, packed)
