@@ -52,6 +52,18 @@ from madraft_tpu.tpusim.engine import make_chunked_fuzz_fn, report, run_pool
 BASELINE_STEPS_PER_SEC = 100_000.0  # BASELINE.json north star
 HBM_PEAK_BYTES_PER_S = 819e9        # TPU v5e; proxy denominator only
 
+# Latency-tail regression gate (ISSUE 10; ROADMAP item 4's exit metric):
+# p99 submit->ack latency of the storm profile, in ticks, measured via the
+# on-device metrics plane. Pinned from round-10 measurements (CPU, seeds
+# 12345): 63 ticks at the 128-tick smoke horizon, 127 at 600- and
+# 1024-tick horizons (the tail is partition-bound, so it grows with the
+# horizon until partitions resolve) — the bound sits one log-spaced bucket
+# above the worst measured value, so only a real distribution shift (a
+# replication/commit-path regression pushing ops past 255 ticks), not
+# bucket-granularity noise, trips it. ci.sh asserts the analogous gate on
+# the durability profile's clean pool leg (see its metrics smoke).
+TAIL_P99_BOUND_TICKS = 255
+
 
 def flagship_config() -> SimConfig:
     return SimConfig(
@@ -428,6 +440,57 @@ def bench_pool_scaling(n_lanes: int, budget_ticks: int) -> dict:
         return {"error": str(e)}
 
 
+def bench_latency(n_clusters: int, n_ticks: int) -> dict:
+    """The latency-tail row (ISSUE 10): the storm profile with the
+    on-device metrics plane enabled — p50/p99 submit->ack (injection ->
+    commit) ticks decoded from the merged per-lane histograms, the
+    `tail_gate` verdict against the pinned p99 bound, and the measured
+    cost of carrying the plane: an A/B against the metrics-OFF program at
+    the SAME batch shape (separate cached programs either way)."""
+    from madraft_tpu.tpusim.metrics import event_summary, latency_summary
+
+    cfg = flagship_config().replace(metrics=True)
+    run_fn = make_chunked_fuzz_fn(cfg, n_clusters, n_ticks)
+    sync = lambda s: np.asarray(s.violations)  # noqa: E731
+    _warmed(lambda: run_fn(12345), sync)
+    best, runs, spread, final = _timed(lambda: run_fn(12345), sync)
+    off_fn = make_chunked_fuzz_fn(flagship_config(), n_clusters, n_ticks)
+    _warmed(lambda: off_fn(12345), sync)
+    off_best, _, _, _ = _timed(lambda: off_fn(12345), sync)
+    rep = report(final)
+    lat = latency_summary(rep.lat_hist.sum(axis=0))
+    p99 = lat["p99_ticks"]
+    steps = n_clusters * n_ticks / best
+    return {
+        "profile": "storm (flagship shape)",
+        "n_clusters": n_clusters,
+        "n_ticks": n_ticks,
+        "runs": runs,
+        "best_wall_s": round(best, 3),
+        "run_spread": round(spread, 3),
+        "metrics_steps_per_sec": round(steps, 1),
+        "metrics_off_steps_per_sec": round(
+            n_clusters * n_ticks / off_best, 1
+        ),
+        # the cost of the plane at equal shape (>= 1.0; stamp rings +
+        # folds are elementwise, so this should stay near 1)
+        "metrics_overhead_factor": round(best / off_best, 3),
+        "latency_ops": lat["ops"],
+        "latency_p50_ticks": lat["p50_ticks"],
+        "latency_p99_ticks": p99,
+        "latency_hist": lat["hist"],
+        "events_per_kstep": {
+            k: round(1000.0 * v / (n_clusters * n_ticks), 3)
+            for k, v in event_summary(rep.ev_counts.sum(axis=0)).items()
+        },
+        "tail_gate": {
+            "p99_ticks": p99,
+            "bound_ticks": TAIL_P99_BOUND_TICKS,
+            "pass": bool(p99 is not None and p99 <= TAIL_P99_BOUND_TICKS),
+        },
+    }
+
+
 def bench_state_footprint() -> dict:
     """Per-lane resident-state footprint (ISSUE 9), wide vs packed, from
     LIVE device buffers (never a schema estimate): the lanes-per-HBM story.
@@ -564,6 +627,23 @@ def bench_coverage(n_lanes: int, budget_ticks: int) -> dict:
     }
 
 
+def next_bench_path() -> str:
+    """The artifact trail's next auto-number: BENCH_r<N+1>.json where N is
+    the highest existing round file (the trail stopped at r05 while rounds
+    6-9 lived only in PERF.md prose — ISSUE 10 satellite resumes it)."""
+    import glob
+    import os
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    ns = [0]
+    for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.match(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+        if m:
+            ns.append(int(m.group(1)))
+    return os.path.join(here, f"BENCH_r{max(ns) + 1:02d}.json")
+
+
 def main() -> None:
     # MADTPU_BENCH_PLATFORM=cpu forces the CPU backend (ci.sh fallback when
     # no healthy accelerator is attached); must run before backend init.
@@ -572,6 +652,30 @@ def main() -> None:
     # third outage of the round); a degraded tunnel must yield a labeled
     # CPU-fallback artifact, not an empty record.
     import os
+
+    # --out [PATH]: additionally write the JSON line to PATH, or — with no
+    # PATH — to the next auto-numbered BENCH_r<N>.json, resuming the
+    # per-round artifact trail. The value is the next argument unless it is
+    # a flag or one of the integer positional scale args; stripped before
+    # the positionals are read so `bench.py 1024 128 --out` keeps working.
+    def _is_int(s):
+        try:
+            int(s)
+            return True
+        except ValueError:
+            return False
+
+    argv = sys.argv[1:]
+    out_path = None
+    if "--out" in argv:
+        i = argv.index("--out")
+        argv.pop(i)
+        if i < len(argv) and not argv[i].startswith("-") \
+                and not _is_int(argv[i]):
+            out_path = argv.pop(i)
+        else:
+            out_path = next_bench_path()
+    sys.argv = [sys.argv[0]] + argv
 
     if len(sys.argv) > 1 and sys.argv[1] == "--pool-scaling-child":
         # the 2-virtual-device scaling subprocess (bench_pool_scaling):
@@ -598,6 +702,9 @@ def main() -> None:
     n_clusters = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
     n_ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
     raft = bench_raft(n_clusters, n_ticks, flagship_config())
+    # latency-tail row (ISSUE 10): p50/p99 + the p99 regression gate on the
+    # storm profile, same //4 sizing as the other secondary rows
+    latency = bench_latency(max(256, n_clusters // 4), n_ticks)
     kv = bench_kv(max(256, n_clusters // 4), max(256, n_ticks // 2))
     # //4 like kv: 512 clusters under-fill the chip for this layer
     # (2.2M steps/s at 512 vs 3.4M at 1024, measured in the r03d soak)
@@ -629,8 +736,7 @@ def main() -> None:
     # the lanes-per-HBM trajectory from this round on
     footprint = bench_state_footprint()
     steps_per_sec = raft.pop("steps_per_sec")
-    print(
-        json.dumps(
+    doc = json.dumps(
             {
                 "metric": "raft_fuzz_cluster_steps_per_sec",
                 "value": round(steps_per_sec, 1),
@@ -676,12 +782,21 @@ def main() -> None:
                     "coverage": covr,
                     "state_footprint_reduction": footprint["reduction"],
                     "state_footprint": footprint,
+                    # latency tail + the p99 regression gate (ISSUE 10)
+                    "latency_p50_ticks": latency["latency_p50_ticks"],
+                    "latency_p99_ticks": latency["latency_p99_ticks"],
+                    "tail_gate_pass": latency["tail_gate"]["pass"],
+                    "latency": latency,
                     "device": str(jax.devices()[0]),
                     **({"degraded": degraded} if degraded else {}),
                 },
             }
-        )
     )
+    print(doc)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(doc + "\n")
+        print(f"[bench] artifact written to {out_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

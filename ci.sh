@@ -174,6 +174,69 @@ print(f"coverage smoke: {summary['retired_violating']} violating, "
       f"(mutated {cov['refills_mutated']}, fresh {cov['refills_fresh']})")
 PY
 
+# metrics smoke (ISSUE 10): the on-device metrics plane through the pool.
+# The planted-bug leg must report nonzero histogram mass (summary latency
+# dict + per-row latency_hist/events columns), and the `stats` verb must
+# render the captured stream; the clean leg is the latency-tail REGRESSION
+# GATE — the durability profile's clean p99 must stay under the pinned
+# bound (bench.py's storm tail_gate analogue; 255 ticks measured at this
+# shape in round 10, 511 = one log-spaced bucket of headroom, so only a
+# real distribution shift trips it). Metrics are a static program flag
+# (SimConfig.metrics joins static_key), so these legs select their own
+# cached programs and the metrics-off pool smoke above stays bit-identical.
+MADTPU_PLATFORM=cpu python - <<'PY'
+import contextlib, io, json, tempfile
+from madraft_tpu.__main__ import main
+
+DURABILITY_P99_BOUND = 511  # ticks; clean-leg p99 measured 255 (round 10)
+
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["pool", "--profile", "durability", "--bug", "ack_before_fsync",
+               "--clusters", "64", "--ticks", "300", "--chunk-ticks", "100",
+               "--budget-ticks", "600", "--seed", "1", "--metrics"])
+stream = buf.getvalue()
+lines = [json.loads(x) for x in stream.strip().splitlines()]
+summary, rows = lines[-1], lines[:-1]
+assert rc == 1, f"metrics bug leg exit {rc} != 1"
+assert summary["retired_violating"] >= 1, summary
+lat = summary["latency"]
+assert lat["ops"] > 0, lat
+assert summary["events"]["commit_advances"] > 0, summary["events"]
+assert all("latency_hist" in r and "events" in r for r in rows), \
+    "JSONL rows missing the metrics columns"
+# cross-surface mass accounting: the summary merges the retired rows PLUS
+# the final harvest's in-flight lanes, so the independent per-row columns
+# must carry nonzero mass and never exceed the merged total
+row_mass = sum(sum(r["latency_hist"]) for r in rows)
+assert 0 < row_mass <= lat["ops"], (row_mass, lat["ops"])
+with tempfile.NamedTemporaryFile("w", suffix=".jsonl") as f:
+    f.write(stream); f.flush()
+    sbuf = io.StringIO()
+    with contextlib.redirect_stdout(sbuf):
+        src = main(["stats", f.name])
+    assert src == 0 and f"ops={lat['ops']}" in sbuf.getvalue(), \
+        "stats verb failed to render the pool stream"
+
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["pool", "--profile", "durability", "--clusters", "64",
+               "--ticks", "300", "--chunk-ticks", "100",
+               "--budget-ticks", "300", "--seed", "12345", "--metrics"])
+clean = json.loads(buf.getvalue().strip().splitlines()[-1])
+assert rc == 0, f"metrics clean leg exit {rc} != 0"
+clat = clean["latency"]
+assert clat["ops"] > 0, clat
+assert clat["p99_ticks"] <= DURABILITY_P99_BOUND, (
+    f"latency TAIL GATE failed: clean durability p99 {clat['p99_ticks']} > "
+    f"{DURABILITY_P99_BOUND} ticks"
+)
+print(f"metrics smoke: bug leg {lat['ops']} ops "
+      f"(p50={lat['p50_ticks']} p99={lat['p99_ticks']}), stats verb OK, "
+      f"clean-leg tail gate p99 {clat['p99_ticks']} <= "
+      f"{DURABILITY_P99_BOUND}")
+PY
+
 # sharded-pool smoke (ISSUE 7): the pod-scale lane-partitioned pool on the
 # 2-virtual-device CI config. The planted-bug leg must retire >= 1 violating
 # cluster and exit 1; the clean leg must retire everything at the horizon
@@ -226,7 +289,12 @@ print(f"sharded pool smoke: bug leg retired "
 PY
 
 echo "== [5/5] bench smoke (1024 clusters x 128 ticks)"
-# prefer the attached accelerator; fall back to CPU if it is absent or hung
+# prefer the attached accelerator; fall back to CPU if it is absent or hung.
+# Artifact trail (ISSUE 10 satellite): a REAL bench round is recorded with
+# `python bench.py --out` — auto-numbers the next BENCH_r<N>.json so the
+# per-round trajectory (BENCH_r01..) stays machine-readable instead of
+# living only in PERF.md prose; the smoke here deliberately does NOT write
+# an artifact (smoke scale is not a round).
 timeout 600 python bench.py 1024 128 \
   || MADTPU_BENCH_PLATFORM=cpu timeout 600 python bench.py 1024 128
 

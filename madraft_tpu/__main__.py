@@ -98,6 +98,11 @@ def _sim_config(args):
         cfg = cfg.replace(fsync_every=args.fsync_every)
     if args.lose_unsynced >= 0:
         cfg = cfg.replace(p_lose_unsynced=args.lose_unsynced)
+    if getattr(args, "metrics", False):
+        # the on-device metrics plane (README "Metrics"): a STATIC program
+        # flag like coverage — metric runs select their own cached
+        # programs, the metrics-off hot path is untouched
+        cfg = cfg.replace(metrics=True)
     return cfg
 
 
@@ -219,8 +224,24 @@ def _report_json(rep, extra=None):
     }
     for f in rep._fields:
         v = getattr(rep, f)
+        # the metrics rows (lat_hist/ev_counts, 2-d or None) get their own
+        # decoded blocks below, not a meaningless *_mean scalar
+        if v is None or getattr(v, "ndim", 0) > 1:
+            continue
         if hasattr(v, "mean"):
             out[f"{f}_mean"] = round(float(v.mean()), 2)
+    # histograms/counters merge across clusters by plain addition —
+    # latency_p50/p99 decode from the merged buckets (ISSUE 10). The two
+    # blocks are independent: the ctrler layer counts events but carries
+    # no clerk latency stamps, so its reports have events without latency.
+    if getattr(rep, "lat_hist", None) is not None:
+        from madraft_tpu.tpusim.metrics import latency_summary
+
+        out["latency"] = latency_summary(rep.lat_hist.sum(axis=0))
+    if getattr(rep, "ev_counts", None) is not None:
+        from madraft_tpu.tpusim.metrics import event_summary
+
+        out["events"] = event_summary(rep.ev_counts.sum(axis=0))
     if extra:
         out.update(extra)
     print(json.dumps(out))
@@ -315,6 +336,17 @@ def cmd_pool(args):
     summary.update(
         {"seed": args.seed, "device": str(dev), "backend": dev.platform}
     )
+    if "latency" in summary:
+        # one-line human digest of the client experience, next to the
+        # summary's violations/s — on stderr, so both --emit modes keep
+        # stdout as a clean JSONL stream
+        lat = summary["latency"]
+        print(
+            f"pool: latency p50={lat['p50_ticks']} p99={lat['p99_ticks']} "
+            f"ticks over {lat['ops']} ops; "
+            f"{summary['violations_per_s']} violations/s",
+            file=sys.stderr,
+        )
     print(json.dumps(summary))
     return 1 if summary["retired_violating"] else 0
 
@@ -399,6 +431,10 @@ def cmd_shardkv_fuzz(args):
         p_crash=0.01 if args.storm else 0.0,
         p_restart=0.2, max_dead=1 if args.storm else 0,
         bug=args.bug,
+        # this verb builds its SimConfig from scratch (it owns the fault
+        # shape), so the metrics flag must be carried explicitly or the
+        # shardkv clerk instrumentation is unreachable from the CLI
+        metrics=getattr(args, "metrics", False),
     )
 
     # mode prerequisites BEFORE config construction — ShardKvConfig's own
@@ -615,6 +651,133 @@ def cmd_explain(args):
     return 0
 
 
+def _collect_stats(streams):
+    """Pull every histogram/counter the metrics plane ever writes out of
+    report JSON streams (one list of lines per input file): fuzz/sweep
+    reports ({"latency": {...}, "events": {...}}), pool summaries (same
+    keys), and pool JSONL rows ({"latency_hist": [...], "events": {...}}).
+    Returns (hist, events, rows_seen) with hist/events merged by plain
+    addition — the fixed bucket layout is what makes cross-file merging
+    correct.
+
+    A pool stream carries BOTH per-row histograms and a summary that
+    already merged them (plus the in-flight lanes' rows) — counting both
+    would double every op. The summary-wins rule is PER STREAM: within one
+    file, a summary-level "latency" dict suppresses that file's bare
+    per-row columns; a rows-only file (e.g. a grep of violating rows from
+    another run) still merges in full next to it."""
+    import numpy as np
+
+    from madraft_tpu.tpusim.config import HIST_BUCKETS, METRIC_EVENTS
+
+    hist = np.zeros(HIST_BUCKETS, np.int64)
+    events = np.zeros(len(METRIC_EVENTS), np.int64)
+    seen = 0
+    for lines in streams:
+        docs = []
+        for raw in lines:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                doc = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(doc, dict):
+                docs.append(doc)
+        use_rows = not any(
+            isinstance(d.get("latency"), dict) and d["latency"].get("hist")
+            for d in docs
+        )
+        for doc in docs:
+            lat = doc.get("latency")
+            row_hist = None
+            if isinstance(lat, dict) and lat.get("hist"):
+                row_hist = lat["hist"]
+            elif use_rows and doc.get("latency_hist"):
+                row_hist = doc["latency_hist"]
+            # an events-ONLY report (the ctrler layer counts events but
+            # carries no latency stamps) still merges — but a pool row
+            # suppressed by its own stream's summary contributes neither
+            ev_only = (
+                "latency_hist" not in doc
+                and not isinstance(lat, dict)
+                and isinstance(doc.get("events"), dict)
+            )
+            if row_hist is None and not ev_only:
+                continue
+            seen += 1
+            if row_hist is not None and len(row_hist) == HIST_BUCKETS:
+                hist += np.asarray(row_hist, np.int64)
+            row_ev = doc.get("events")
+            if isinstance(row_ev, dict):
+                for i, name in enumerate(METRIC_EVENTS):
+                    events[i] += int(row_ev.get(name, 0))
+    return hist, events, seen
+
+
+def cmd_stats(args):
+    """Render the metrics plane of any report artifact (ISSUE 10): feed it
+    a fuzz/sweep report, a pool summary + JSONL stream, or any mix of
+    files; it merges every histogram/counter row it finds (fixed buckets
+    sum across sources) and prints the latency distribution, p50/p99, and
+    the liveness-counter table. A read-only renderer: exit 0 when metrics
+    were found, exit 2 when the input carries none (e.g. a metrics-off
+    report — say so rather than print an empty table)."""
+    from madraft_tpu.tpusim.config import METRIC_EVENTS
+    from madraft_tpu.tpusim.metrics import (
+        latency_summary,
+        render_histogram,
+    )
+
+    streams = []
+    paths = args.inputs or ["-"]
+    for path in paths:
+        if path == "-":
+            streams.append(sys.stdin.read().splitlines())
+        else:
+            try:
+                with open(path) as f:
+                    streams.append(f.read().splitlines())
+            except OSError as e:
+                print(f"stats: {e}", file=sys.stderr)
+                raise SystemExit(2)
+    hist, events, seen = _collect_stats(streams)
+    if not seen:
+        print("stats: no metrics found in the input — was the run made "
+              "with --metrics?", file=sys.stderr)
+        return 2
+    lat = latency_summary(hist)
+    try:
+        _print_stats(args, hist, events, seen, lat, METRIC_EVENTS,
+                     render_histogram)
+    except BrokenPipeError:  # e.g. `stats ... | head` — not an error
+        pass
+    return 0
+
+
+def _print_stats(args, hist, events, seen, lat, METRIC_EVENTS,
+                 render_histogram):
+    print(f"sources merged: {seen}")
+    print(f"latency: ops={lat['ops']} p50={lat['p50_ticks']} "
+          f"p99={lat['p99_ticks']} (ticks; log-spaced buckets, quantile = "
+          f"bucket upper edge)")
+    for line in render_histogram(hist):
+        print(line)
+    if events.any():
+        print("events:")
+        width = max(len(n) for n in METRIC_EVENTS)
+        for i, name in enumerate(METRIC_EVENTS):
+            print(f"  {name:<{width}}  {int(events[i])}")
+    if args.json:
+        print(json.dumps({
+            "sources": seen,
+            "latency": lat,
+            "events": {n: int(events[i])
+                       for i, n in enumerate(METRIC_EVENTS)},
+        }))
+
+
 def cmd_bridge(args):
     from madraft_tpu import bridge
     from madraft_tpu.tpusim.config import violation_names
@@ -672,6 +835,13 @@ def main(argv=None) -> int:
                              "commit_any_term | grant_any_vote | "
                              "forget_voted_for | no_truncate | "
                              "ack_before_fsync)")
+        sp.add_argument("--metrics", action="store_true",
+                        help="on-device metrics plane (README 'Metrics'): "
+                             "per-lane submit->ack latency histograms + "
+                             "liveness-event counters folded inside the "
+                             "compiled step; reports gain latency p50/p99 "
+                             "and event columns (separate cached programs "
+                             "— the metrics-off hot path is untouched)")
         sp.add_argument("--profile", default="",
                         choices=["", "storm", "fig8", "revote", "durability"],
                         help="tuned fault-storm preset (overrides --nodes "
@@ -862,7 +1032,26 @@ def main(argv=None) -> int:
     sp.add_argument("--cluster", type=int, required=True)
     sp.set_defaults(fn=cmd_bridge)
 
+    sp = sub.add_parser(
+        "stats",
+        help="render the metrics plane of any report artifact: merges the "
+             "latency histograms and event counters found in fuzz/sweep "
+             "reports, pool summaries, and pool JSONL rows (files or "
+             "stdin), prints the distribution + p50/p99 + counter table",
+    )
+    sp.add_argument("inputs", nargs="*", metavar="FILE",
+                    help="report/JSONL files to merge ('-' or none = stdin)")
+    sp.add_argument("--json", action="store_true",
+                    help="additionally print the merged digest as one "
+                         "machine-readable JSON line")
+    sp.set_defaults(fn=cmd_stats)
+
     args = p.parse_args(argv)
+    if args.cmd == "stats":
+        # a pure host-side renderer: no compiled programs, no accelerator —
+        # skip backend init entirely (a degraded tunnel must not block
+        # reading a report file)
+        return args.fn(args)
     # Must run before any backend init. Honors --platform > MADTPU_PLATFORM
     # > JAX_PLATFORMS (re-asserted via jax.config because the container's
     # startup hook force-registers the tunnel regardless of the env var),

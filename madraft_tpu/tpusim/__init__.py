@@ -11,7 +11,17 @@ independent (seed x fault-schedule) clusters per step; safety invariants
 (election safety, log matching, commit durability) run as on-device reductions.
 """
 
-from madraft_tpu.tpusim.config import CoverageConfig, SimConfig
+from madraft_tpu.tpusim.config import (
+    HIST_BUCKETS,
+    METRIC_EVENTS,
+    CoverageConfig,
+    SimConfig,
+)
+from madraft_tpu.tpusim.metrics import (
+    event_summary,
+    latency_summary,
+    quantile_from_hist,
+)
 from madraft_tpu.tpusim.state import ClusterState, init_cluster
 from madraft_tpu.tpusim.step import step_cluster
 from madraft_tpu.tpusim.engine import FuzzReport, fuzz, make_fuzz_fn
@@ -61,6 +71,11 @@ from madraft_tpu.tpusim.shardkv import (
 __all__ = [
     "SimConfig",
     "CoverageConfig",
+    "HIST_BUCKETS",
+    "METRIC_EVENTS",
+    "event_summary",
+    "latency_summary",
+    "quantile_from_hist",
     "CtrlerConfig",
     "CtrlerFuzzReport",
     "CtrlerState",

@@ -47,6 +47,18 @@ class SimConfig:
     #                          (power of two: canonical lane = (index-1) & (cap-1))
     ae_max: int = 4          # max entries carried per AppendEntries message
 
+    # On-device metrics plane (ISSUE 10): latency-tail histograms and
+    # per-lane liveness-event counters folded INSIDE the compiled step.
+    # STATIC on purpose, exactly like `bug` and the coverage knobs: the
+    # metric arrays' shapes derive from it (metrics_dims — zero-size with
+    # metrics off, so the metrics-off ClusterState carries zero extra
+    # bytes), it joins static_key, and a metrics run therefore selects its
+    # own cached programs — the metrics-off hot path, its golden guards,
+    # and its packed bytes_per_lane are untouched. Metrics add NO PRNG
+    # draws, so a metrics-on run's trajectory (violations, commits, every
+    # draw) is bit-identical to the same run with metrics off.
+    metrics: bool = False
+
     # Packed-state tick ceiling (ISSUE 9): the per-lane tick count the
     # PACKED ClusterState layout (state.PackedClusterState) is sized for.
     # Every tick-derived quantity is bounded by it — term bumps at most
@@ -233,6 +245,7 @@ class SimConfig:
         return SimConfig(
             n_nodes=self.n_nodes, log_cap=self.log_cap, ae_max=self.ae_max,
             max_lane_ticks=self.max_lane_ticks, compact_every=1, bug=self.bug,
+            metrics=self.metrics,
         )
 
 
@@ -305,6 +318,11 @@ class PackedBounds(NamedTuple):
     index: int   # log_len/base/commit/next_idx/match/prev/... (absolute)
     cmd: int     # log_val/shadow_val payloads (excluding the NOOP sentinel)
     rel_stamp: int  # mailbox stamp minus cluster tick (0 = empty slot)
+    event: int   # any ev_counts liveness counter (ISSUE 10): every counted
+    #              event fires at most once per NODE per tick (pick_one
+    #              delivers one message per destination per type; elections/
+    #              term bumps/crashes/restarts/commit advances are per-node
+    #              facts), so n_nodes * max_lane_ticks bounds every row entry
 
 
 def packed_bounds(cfg: "SimConfig") -> PackedBounds:
@@ -315,7 +333,56 @@ def packed_bounds(cfg: "SimConfig") -> PackedBounds:
         index=2 * t + 1,
         cmd=cfg.n_nodes * (t + 1),
         rel_stamp=254,  # u8 with 0 reserved for "empty" => delay_max <= 253
+        event=cfg.n_nodes * t,
     )
+
+
+# ---------------------------------------------------------------------------
+# On-device metrics plane (ISSUE 10; fold helpers live in metrics.py, the
+# instrumentation in step.py/kv.py/shardkv.py). These constants shape the
+# metric arrays, so they live here with the other static shape knobs.
+#
+# Latency histogram: fixed log-spaced (power-of-two) buckets over
+# submit->ack ticks — bucket 0 covers [0, 1], bucket k >= 1 covers
+# [2^k, 2^(k+1) - 1], and the last bucket is open-ended. 16 buckets span
+# latencies past 32k ticks, far beyond any configured horizon, and the
+# fixed layout is what lets histograms MERGE by plain addition across
+# lanes, shards, and report files (the DrJAX-style MapReduce fold:
+# millions of lane-ticks of latency come back as one small row per lane).
+#
+# Event counters: one i32 row per lane, indexed by METRIC_EVENTS order.
+# Every entry is a cumulative per-lane count of a liveness event; the
+# delivery counters use the trace module's exact derivation (one delivery
+# per destination per mailbox type per tick), so their sum equals
+# msg_count — a cross-check the tests pin.
+# ---------------------------------------------------------------------------
+
+HIST_BUCKETS = 16
+
+METRIC_EVENTS = (
+    "elections_won",     # candidate reached majority and became leader
+    "term_bumps",        # a node's term increased this tick (any cause)
+    "crashes",           # node kills (incl. suffix-loss crashes)
+    "restarts",          # node recoveries
+    "rv_req_delivered",  # deliveries by RPC type (sum == msg_count)
+    "rv_rsp_delivered",
+    "ae_req_delivered",
+    "ae_rsp_delivered",
+    "snap_delivered",
+    "commit_advances",   # nodes whose commit index advanced this tick
+)
+
+
+def metrics_dims(cfg: "SimConfig") -> tuple:
+    """(hist_buckets, n_events, stamp_cap) — the metric arrays' shapes for
+    one config. ALL ZERO with metrics off: the metrics-off ClusterState
+    carries zero-size leaves (no bytes, no HBM, no packed-layout growth),
+    which is what keeps the metrics-off programs' reports — and the ci.sh
+    bytes_per_lane bound — untouched. stamp_cap sizes the per-entry
+    submit-stamp rings (log_tick / shadow_sub), which mirror log_cap."""
+    if not cfg.metrics:
+        return 0, 0, 0
+    return HIST_BUCKETS, len(METRIC_EVENTS), cfg.log_cap
 
 
 # Violation bitmask values (oracle reductions; raft oracles live in step.py,

@@ -842,6 +842,11 @@ class CtrlerFuzzReport(NamedTuple):
     msg_count: np.ndarray
     snap_installs: np.ndarray
     walker_stalled: np.ndarray        # bool: oracle coverage lost (see state)
+    # metrics plane (ISSUE 10): liveness counters only — the ctrler clerk
+    # carries no latency stamps yet (the kv/shardkv clerk_sub treatment is
+    # queued with ROADMAP item 4's scenario work), so there is no lat_hist
+    # field and a --metrics run reports events without a latency dict
+    ev_counts: Optional[np.ndarray] = None
 
     @property
     def n_violating(self) -> int:
@@ -975,6 +980,10 @@ def ctrler_report(final: CtrlerState) -> CtrlerFuzzReport:
         msg_count=np.asarray(final.raft.msg_count),
         snap_installs=np.asarray(final.raft.snap_install_count),
         walker_stalled=np.asarray(final.w_stalled),
+        ev_counts=(
+            np.asarray(final.raft.ev_counts)
+            if final.raft.ev_counts.size else None
+        ),
     )
 
 

@@ -18,6 +18,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim import coverage as _cov
+from madraft_tpu.tpusim import metrics as _metrics
 from madraft_tpu.tpusim.config import (
     CoverageConfig,
     Knobs,
@@ -59,6 +60,10 @@ class FuzzReport(NamedTuple):
     committed: np.ndarray             # entries ever committed (shadow length)
     msg_count: np.ndarray             # delivered messages
     snap_installs: np.ndarray         # install-snapshot deliveries (2D metric)
+    # metrics plane (ISSUE 10): None with cfg.metrics off, else per-cluster
+    # [n, HIST_BUCKETS] / [n, len(METRIC_EVENTS)] rows (rows merge by sum)
+    lat_hist: Optional[np.ndarray] = None
+    ev_counts: Optional[np.ndarray] = None
 
     @property
     def n_violating(self) -> int:
@@ -282,6 +287,11 @@ class PoolHarvest(NamedTuple):
     msg_count: jax.Array
     snap_installs: jax.Array
     ticks_run: jax.Array           # the cluster's age (= state.tick)
+    # metrics plane (ISSUE 10): [n, HIST_BUCKETS] / [n, len(METRIC_EVENTS)]
+    # per-lane rows (ZERO-SIZE trailing axis with metrics off — the
+    # metrics-off harvest fetch is unchanged); summaries merge them by sum
+    lat_hist: jax.Array
+    ev_counts: jax.Array
 
 
 def _constraint(mesh: Optional[Mesh]):
@@ -295,7 +305,7 @@ def _retired_row(h, lane: int, wall: float, viol_total: int) -> dict:
     builder for the plain and coverage pools, so the schema cannot drift
     between them (the coverage pool appends its extra columns)."""
     mask = int(h.violations[lane])
-    return {
+    row = {
         "cluster_id": int(h.ids[lane]),
         "ticks_run": int(h.ticks_run[lane]),
         "violations": mask,
@@ -310,6 +320,13 @@ def _retired_row(h, lane: int, wall: float, viol_total: int) -> dict:
             round(viol_total / wall, 3) if wall > 0 else None
         ),
     }
+    if h.lat_hist.shape[-1]:
+        # metrics columns (ISSUE 10): the retiring lane's whole-lifetime
+        # histogram and counter rows ride every JSONL report, so any single
+        # retired cluster's tail is inspectable (and `stats` re-merges them)
+        row["latency_hist"] = [int(x) for x in h.lat_hist[lane]]
+        row["events"] = _metrics.event_summary(h.ev_counts[lane])
+    return row
 
 
 def _pool_summary(n_clusters: int, horizon: int, chunk_ticks: int,
@@ -322,6 +339,12 @@ def _pool_summary(n_clusters: int, horizon: int, chunk_ticks: int,
     id-scheme-specific bookkeeping (next_cluster_id, or the lane scheme's
     id_scheme / devices / id_watermark)."""
     dispatched = lane_ticks * n_clusters
+    extra = {}
+    if acct.hist_total is not None:
+        # merged across lanes (and, in a sharded pool, across shards) by
+        # plain addition — the summary's client-experience digest
+        extra["latency"] = _metrics.latency_summary(acct.hist_total)
+        extra["events"] = _metrics.event_summary(acct.ev_total)
     return {
         "lanes": n_clusters,
         "horizon": horizon,
@@ -344,6 +367,7 @@ def _pool_summary(n_clusters: int, horizon: int, chunk_ticks: int,
         ),
         **tele,
         **id_fields,
+        **extra,
     }
 
 
@@ -372,6 +396,11 @@ class _PoolAccount:
         self.refills_mutated = 0
         self.refills_fresh = 0
         self.lane_new_fps_total = 0
+        # metrics extras (ISSUE 10; stay None on metrics-off harvests):
+        # retired lanes' histogram/counter rows merged by SUM — the pool-
+        # summary analogue of the sharded seen-set OR-reduce
+        self.hist_total: Optional[np.ndarray] = None
+        self.ev_total: Optional[np.ndarray] = None
 
     def consume(self, h, wall: float, children_ran: bool) -> None:
         """Account one fetched harvest. ``children_ran`` is True iff a
@@ -384,6 +413,12 @@ class _PoolAccount:
             seen_now = int(h.seen_bits)
             self.new_fp_per_gen.append(seen_now - self.seen_prev)
             self.seen_prev = seen_now
+        if h.lat_hist.shape[-1] and self.hist_total is None:
+            self.hist_total = np.zeros(h.lat_hist.shape[-1], np.int64)
+            self.ev_total = np.zeros(h.ev_counts.shape[-1], np.int64)
+        if self.hist_total is not None and h.retired.any():
+            self.hist_total += h.lat_hist[h.retired].sum(axis=0)
+            self.ev_total += h.ev_counts[h.retired].sum(axis=0)
         for lane in np.nonzero(h.retired)[0]:
             mask = int(h.violations[lane])
             fvt = int(h.first_violation_tick[lane])
@@ -422,6 +457,12 @@ class _PoolAccount:
         self.effective += int(h.ticks_run[~h.retired].sum())
         if hasattr(h, "new_fps"):
             self.lane_new_fps_total += int(h.new_fps[~h.retired].sum())
+        if self.hist_total is not None:
+            # in-flight lanes' ops are real observations (same convention
+            # as their effective ticks above); retired rows were already
+            # merged at their harvest, so nothing double-counts
+            self.hist_total += h.lat_hist[~h.retired].sum(axis=0)
+            self.ev_total += h.ev_counts[~h.retired].sum(axis=0)
 
 
 def _pipeline(launch_chunk, launch_harvest, acct: _PoolAccount,
@@ -734,6 +775,8 @@ def _pool_snapshot(states, retired, ids) -> PoolHarvest:
         msg_count=states.msg_count,
         snap_installs=states.snap_install_count,
         ticks_run=states.tick,
+        lat_hist=states.lat_hist,
+        ev_counts=states.ev_counts,
     )
 
 
@@ -1060,6 +1103,8 @@ class CovHarvest(NamedTuple):
     msg_count: jax.Array
     snap_installs: jax.Array
     ticks_run: jax.Array
+    lat_hist: jax.Array     # metrics rows (PoolHarvest; zero-size when off)
+    ev_counts: jax.Array
     new_fps: jax.Array      # i32 [n]: new fingerprints this lane discovered
     #                         since ITS refill (its whole lifetime)
     refill_kind: jax.Array  # i32 [n]: how this lane's knobs were produced
@@ -1579,6 +1624,7 @@ def make_sweep_fn(
 
 
 def report(final: ClusterState) -> FuzzReport:
+    has_metrics = final.lat_hist.size > 0
     return FuzzReport(
         violations=np.asarray(final.violations),
         first_violation_tick=np.asarray(final.first_violation_tick),
@@ -1586,6 +1632,8 @@ def report(final: ClusterState) -> FuzzReport:
         committed=np.asarray(final.shadow_len),
         msg_count=np.asarray(final.msg_count),
         snap_installs=np.asarray(final.snap_install_count),
+        lat_hist=np.asarray(final.lat_hist) if has_metrics else None,
+        ev_counts=np.asarray(final.ev_counts) if has_metrics else None,
     )
 
 

@@ -47,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
 from madraft_tpu.tpusim.engine import FuzzProgram
+from madraft_tpu.tpusim.metrics import fold_latencies
 from madraft_tpu.tpusim.state import (
     ClusterState,
     I32,
@@ -197,6 +198,12 @@ class KvState(NamedTuple):
     #                          reference ClerkCore's leader_ cache, fed by
     #                          NotLeader{hint} replies (client.rs:32-63)
     clerk_wait: jax.Array    # i32 await-reply countdown (see retry_wait)
+    clerk_sub: jax.Array     # i32 [NC] submit stamp: tick the outstanding op
+    #                          STARTED (ISSUE 10 metrics; zero-size with
+    #                          cfg.metrics off). At ack, t - clerk_sub folds
+    #                          into the raft state's lat_hist — the client-
+    #                          experienced submit->ack latency, retries and
+    #                          leader-hunting included
     # --- reads-linearizability oracle state ---
     # The log totally orders mutations (Appends and Puts), so key k's
     # observable state IS its committed MUTATION VERSION — the count of
@@ -271,6 +278,7 @@ def init_kv_cluster(
         clerk_acked=jnp.zeros((nc,), I32),
         clerk_leader=jnp.full((nc,), -1, I32),
         clerk_wait=jnp.zeros((nc,), I32),
+        clerk_sub=jnp.zeros((nc if cfg.metrics else 0,), I32),
         truth_count=jnp.zeros((nk,), I32),
         truth_max_seq=jnp.zeros((nc,), I32),
         clerk_get_lo=jnp.zeros((nc,), I32),
@@ -503,6 +511,13 @@ def kv_step(
     clerk_out = ks.clerk_out & ~newly_acked
     gets_done = ks.gets_done + done_get.astype(I32)
     clerk_last_obs = jnp.where(done_get, clerk_get_obs, ks.clerk_last_obs)
+    # metrics (ISSUE 10): the ack is the clerk's Ok reply — fold the op's
+    # whole submit->ack latency (stamped at op START, so retries and
+    # NotLeader hunting are inside the measured window, exactly what a
+    # client experiences) into the cluster's latency histogram
+    lat_hist = s.lat_hist
+    if cfg.metrics:
+        lat_hist = fold_latencies(lat_hist, t - ks.clerk_sub, newly_acked)
 
     # start fresh ops / retry pending ones
     kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 4)
@@ -534,6 +549,12 @@ def kv_step(
     )
     clerk_get_lo = jnp.where(start, truth_at_new, ks.clerk_get_lo)
     clerk_get_obs = jnp.where(start, -1, clerk_get_obs)
+    clerk_sub = ks.clerk_sub
+    if cfg.metrics:
+        # submit stamp: a fresh op's latency window opens NOW (an op never
+        # acks in its start tick — the serve path below requires ~start and
+        # the shadow ack needs a commit, which takes at least one tick)
+        clerk_sub = jnp.where(start, t, clerk_sub)
     clerk_out = clerk_out | start
     retry = clerk_out & (
         start
@@ -592,6 +613,10 @@ def kv_step(
     retry = retry & ~served
     # record the served value so history exporters (bridge) can see it
     clerk_last_obs = jnp.where(served, local_cnt, clerk_last_obs)
+    if cfg.metrics:
+        # the bug-mode local serve is an ack too (served ops are ~start, so
+        # their stamp is untouched by this tick's start update above)
+        lat_hist = fold_latencies(lat_hist, t - clerk_sub, served)
 
     violations = s.violations | viol
     first_violation_tick = jnp.where(
@@ -673,6 +698,10 @@ def kv_step(
         first_violation_tick=first_violation_tick,
         # next tick's compaction boundary: never past what we've applied
         compact_floor=applied,
+        # the clerk-ack latency folds (service entries carry log_tick 0 —
+        # _check_kv_cfg pins p_client_cmd=0, so the raft layer's own
+        # commit fold never double-counts a clerk op)
+        lat_hist=lat_hist,
     )
     return KvState(
         raft=raft,
@@ -683,6 +712,7 @@ def kv_step(
         clerk_acked=clerk_acked,
         clerk_leader=clerk_leader,
         clerk_wait=clerk_wait,
+        clerk_sub=clerk_sub,
         truth_count=truth_count,
         truth_max_seq=truth_max_seq,
         clerk_get_lo=clerk_get_lo,
@@ -710,6 +740,10 @@ class KvFuzzReport(NamedTuple):
     committed: np.ndarray             # committed log entries per cluster
     msg_count: np.ndarray
     snap_installs: np.ndarray         # install-snapshot deliveries
+    # metrics plane (ISSUE 10): clerk submit->ack histograms + liveness
+    # counters per cluster; None with cfg.metrics off
+    lat_hist: Optional[np.ndarray] = None
+    ev_counts: Optional[np.ndarray] = None
 
     @property
     def n_violating(self) -> int:
@@ -839,6 +873,7 @@ def make_kv_sweep_fn(
 
 
 def kv_report(final: KvState) -> KvFuzzReport:
+    has_metrics = final.raft.lat_hist.size > 0
     return KvFuzzReport(
         violations=np.asarray(final.raft.violations),
         first_violation_tick=np.asarray(final.raft.first_violation_tick),
@@ -847,6 +882,8 @@ def kv_report(final: KvState) -> KvFuzzReport:
         committed=np.asarray(final.raft.shadow_len),
         msg_count=np.asarray(final.raft.msg_count),
         snap_installs=np.asarray(final.raft.snap_install_count),
+        lat_hist=np.asarray(final.raft.lat_hist) if has_metrics else None,
+        ev_counts=np.asarray(final.raft.ev_counts) if has_metrics else None,
     )
 
 

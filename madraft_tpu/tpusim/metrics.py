@@ -1,0 +1,134 @@
+"""On-device metrics plane helpers (ISSUE 10; ROADMAP item 4).
+
+The instrumentation itself lives where the state lives — step.py folds
+commit latencies and counts liveness events, kv.py/shardkv.py fold clerk
+submit->ack latencies — and this module is the ONE copy of everything
+around it: the log-spaced bucket layout, the device-side fold, the
+host-side quantile decode, and the merge/render utilities the reports,
+bench gate, and the `stats` CLI verb share.
+
+Bucket convention (config.HIST_BUCKETS fixed log-spaced buckets):
+  bucket 0        latency in [0, 1] ticks
+  bucket k >= 1   latency in [2^k, 2^(k+1) - 1]
+  last bucket     open-ended: [2^(HB-1), inf)
+Quantile decode (``quantile_from_hist``) reports the UPPER edge of the
+bucket holding the quantile — a conservative estimate whose error is
+bounded by the bucket width — except the open-ended last bucket, which
+reports its lower edge (the best defensible number it has). Fixed edges
+mean histograms merge by plain addition: per-lane rows sum into a pool
+summary, shard rows sum at harvest, and report files sum in `stats`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from madraft_tpu.tpusim.config import HIST_BUCKETS, METRIC_EVENTS
+
+I32 = jnp.int32
+
+# Lower edges of buckets 1..HB-1 (bucket 0's lower edge is 0). Shared by
+# the device fold and the host decode so the two cannot disagree about the
+# layout; the cross-check test recomputes bucket indices via a DIFFERENT
+# host implementation (np.searchsorted) on raw stamps.
+BUCKET_EDGES = tuple(1 << k for k in range(1, HIST_BUCKETS))
+
+
+def fold_latencies(hist: jnp.ndarray, lat: jnp.ndarray,
+                   mask: jnp.ndarray) -> jnp.ndarray:
+    """Device-side fold: add each masked latency's bucket to ``hist``
+    ([HIST_BUCKETS] i32). ``lat``/``mask`` are any matching shape; the
+    fold is a one-hot sum (no scatters — the TPU idiom everywhere else in
+    the step)."""
+    edges = jnp.asarray(BUCKET_EDGES, I32)
+    flat_lat = lat.reshape(-1)
+    flat_mask = mask.reshape(-1)
+    bucket = jnp.sum(
+        (flat_lat[:, None] >= edges[None, :]).astype(I32), axis=1
+    )  # [m] in [0, HB-1]
+    oh = (
+        jnp.arange(HIST_BUCKETS, dtype=I32)[None, :] == bucket[:, None]
+    ) & flat_mask[:, None]
+    return hist + jnp.sum(oh, axis=0, dtype=I32)
+
+
+def host_bucket(lat: np.ndarray) -> np.ndarray:
+    """Host-side bucket index per latency — deliberately a DIFFERENT
+    implementation (searchsorted over the edges) than the device fold, so
+    the traced-replay cross-check exercises the layout, not one shared
+    function."""
+    return np.searchsorted(np.asarray(BUCKET_EDGES), np.asarray(lat),
+                           side="right")
+
+
+def bucket_bounds(k: int) -> tuple:
+    """(lower, upper) latency bounds of bucket k; upper is None for the
+    open-ended last bucket."""
+    lo = 0 if k == 0 else (1 << k)
+    hi = None if k == HIST_BUCKETS - 1 else (1 << (k + 1)) - 1
+    return lo, hi
+
+
+def quantile_from_hist(hist, q: float) -> Optional[int]:
+    """The q-quantile latency estimate (ticks) from a merged histogram:
+    the upper edge of the bucket where the cumulative count first reaches
+    q * total (lower edge for the open-ended last bucket). None when the
+    histogram is empty."""
+    h = np.asarray(hist, dtype=np.int64)
+    total = int(h.sum())
+    if total == 0:
+        return None
+    k = int(np.searchsorted(np.cumsum(h), q * total, side="left"))
+    k = min(k, HIST_BUCKETS - 1)
+    lo, hi = bucket_bounds(k)
+    return lo if hi is None else hi
+
+
+def latency_summary(hist, ms_per_tick: Optional[int] = None) -> dict:
+    """The latency dict every report surface carries: observed-op count,
+    p50/p99 decoded from the buckets, and the raw histogram row (so the
+    dict itself stays mergeable downstream — `stats` re-sums these)."""
+    h = np.asarray(hist, dtype=np.int64)
+    out = {
+        "ops": int(h.sum()),
+        "p50_ticks": quantile_from_hist(h, 0.50),
+        "p99_ticks": quantile_from_hist(h, 0.99),
+        "hist": [int(x) for x in h],
+    }
+    if ms_per_tick and out["p99_ticks"] is not None:
+        out["p50_ms"] = out["p50_ticks"] * ms_per_tick
+        out["p99_ms"] = out["p99_ticks"] * ms_per_tick
+    return out
+
+
+def event_summary(ev) -> dict:
+    """METRIC_EVENTS-keyed counter dict from one merged ev_counts row."""
+    ev = np.asarray(ev, dtype=np.int64)
+    return {name: int(ev[i]) for i, name in enumerate(METRIC_EVENTS)}
+
+
+def render_histogram(hist, width: int = 40) -> list:
+    """ASCII rendering of one merged histogram (the `stats` verb body):
+    one line per non-empty bucket range, bar scaled to the largest."""
+    h = np.asarray(hist, dtype=np.int64)
+    total = int(h.sum())
+    if total == 0:
+        return ["(no latency samples)"]
+    top = int(h.max())
+    lines = []
+    cum = 0
+    for k in range(HIST_BUCKETS):
+        if h[k] == 0:
+            continue
+        cum += int(h[k])
+        lo, hi = bucket_bounds(k)
+        rng = f"[{lo}, {hi}]" if hi is not None else f"[{lo}, inf)"
+        bar = "#" * max(1, round(width * int(h[k]) / top))
+        lines.append(
+            f"{rng:>16} ticks  {int(h[k]):>10}  {100.0 * cum / total:5.1f}%  "
+            f"{bar}"
+        )
+    return lines

@@ -74,9 +74,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
+from madraft_tpu.tpusim.config import (
+    LEADER,
+    NOOP_CMD,
+    SimConfig,
+    metrics_dims,
+)
 from madraft_tpu.tpusim.ctrler import _rebalance as _ctrl_rebalance
 from madraft_tpu.tpusim.engine import FuzzProgram
+from madraft_tpu.tpusim.metrics import fold_latencies
 from madraft_tpu.tpusim.state import (
     ClusterState,
     I32,
@@ -478,6 +484,16 @@ class ShardKvState(NamedTuple):
     clerk_get_lo: jax.Array       # i32 [NC] truth_count[shard] at invoke
     clerk_get_obs: jax.Array      # i32 [NC] observed count; -1 = no reply yet
     gets_done: jax.Array          # i32 [NC] completed Gets
+    # --- metrics plane (ISSUE 10; zero-size with cfg.metrics off) ---
+    clerk_sub: jax.Array          # i32 [NC] submit stamp: tick the
+    #                               outstanding op started (kv.py clerk_sub)
+    lat_hist: jax.Array           # i32 [HIST_BUCKETS] DEPLOYMENT-level clerk
+    #                               submit->ack histogram — acks happen at
+    #                               the service layer (walker accept), so
+    #                               the fold lives here, not in any single
+    #                               group's raft row; migration stalls and
+    #                               WrongGroup re-query hunts are inside the
+    #                               measured window
     # --- truth walker (oracle ground truth at each group's shadow frontier) ---
     w_frontier: jax.Array        # i32 [G] entries walked (absolute shadow index)
     w_cfg: jax.Array             # i32 [G]
@@ -701,6 +717,8 @@ def init_shardkv_cluster(
         clerk_get_lo=jnp.zeros((nc,), I32),
         clerk_get_obs=jnp.full((nc,), -1, I32),
         gets_done=jnp.zeros((nc,), I32),
+        clerk_sub=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        lat_hist=jnp.zeros(metrics_dims(cfg)[:1], I32),
         w_frontier=jnp.zeros((g,), I32),
         w_cfg=jnp.zeros((g,), I32),
         w_phase=phase0[:, 0, :],
@@ -1619,6 +1637,12 @@ def shardkv_step(
     clerk_acked = jnp.where(newly, st.clerk_seq, st.clerk_acked)
     clerk_out = st.clerk_out & ~newly
     gets_done = st.gets_done + done_get.astype(I32)
+    # metrics (ISSUE 10): fold the acked op's whole submit->ack latency —
+    # stamped at op start, so config hunts, WrongGroup retries, and
+    # migration stalls are all inside the measured window (kv.py fold)
+    lat_hist = st.lat_hist
+    if cfg.metrics:
+        lat_hist = fold_latencies(lat_hist, t - st.clerk_sub, newly)
     # WrongGroup re-query (client.rs:16-25): a marked clerk re-learns NOW
     learn = jax.random.bernoulli(kc[0], skn.p_cfg_learn, (nc,)) | (
         skn.requery_wrong_group & st.clerk_wrong
@@ -1651,6 +1675,9 @@ def shardkv_step(
     truth_at_new = jnp.sum(jnp.where(sh_oh_new, truth_count[None, :], 0), axis=1)
     clerk_get_lo = jnp.where(start, truth_at_new, st.clerk_get_lo)
     clerk_get_obs = jnp.where(start, -1, clerk_get_obs)
+    clerk_sub = st.clerk_sub
+    if cfg.metrics:
+        clerk_sub = jnp.where(start, t, clerk_sub)  # submit stamp
     clerk_out = clerk_out | start
     retry = clerk_out & (start | jax.random.bernoulli(kc[3], skn.p_retry, (nc,)))
     tgt_node = jax.random.randint(kc[4], (nc,), 0, n, dtype=I32)
@@ -1735,6 +1762,10 @@ def shardkv_step(
     clerk_out = clerk_out & ~served
     gets_done = gets_done + served.astype(I32)
     retry = retry & ~served
+    if cfg.metrics:
+        # the bug-mode local serve is an ack too (served requires ~start,
+        # so the op's stamp predates this tick's start update)
+        lat_hist = fold_latencies(lat_hist, t - clerk_sub, served)
     # WrongGroup detection (client.rs:16-25): this submit reached an alive
     # LEADER of the believed owner group and the shard is not serving there
     # — the clerk is marked and (under requery_wrong_group) re-learns the
@@ -1799,6 +1830,7 @@ def shardkv_step(
         clerk_wrong=clerk_wrong, clerk_acked=clerk_acked,
         clerk_get_lo=clerk_get_lo, clerk_get_obs=clerk_get_obs,
         gets_done=gets_done,
+        clerk_sub=clerk_sub, lat_hist=lat_hist,
         w_frontier=w_frontier, w_cfg=w_cfg, w_phase=w_phase,
         w_hash=w_hash, w_count=w_count, w_last_seq=w_last_seq,
         frz_cfg=frz_cfg, frz_hash=frz_hash,
@@ -1827,6 +1859,11 @@ class ShardKvFuzzReport(NamedTuple):
     #                                   frontier (0 when the mode is off)
     ctrl_walker_stalled: np.ndarray   # live-ctrler: oracle coverage lost
     #                                   (sticky; False when the mode is off)
+    # metrics plane (ISSUE 10): per-deployment clerk submit->ack histograms
+    # and liveness counters summed over the deployment's group rafts (plus
+    # the live controller cluster); None with cfg.metrics off
+    lat_hist: Optional[np.ndarray] = None
+    ev_counts: Optional[np.ndarray] = None
 
     @property
     def n_violating(self) -> int:
@@ -1996,6 +2033,14 @@ def shardkv_report(final: ShardKvState) -> ShardKvFuzzReport:
             - 1
         ),
         ctrl_walker_stalled=np.asarray(final.ctrl_w_stalled),
+        lat_hist=(
+            np.asarray(final.lat_hist) if final.lat_hist.size else None
+        ),
+        ev_counts=(
+            np.asarray(final.rafts.ev_counts).sum(axis=1)
+            + np.asarray(final.ctrl.ev_counts)
+            if final.rafts.ev_counts.size else None
+        ),
     )
 
 

@@ -50,6 +50,7 @@ from madraft_tpu.tpusim.config import (
     FOLLOWER,
     NOOP_CMD,
     SimConfig,
+    metrics_dims,
     packed_bounds,
 )
 
@@ -157,6 +158,33 @@ class ClusterState(NamedTuple):
     first_leader_tick: jax.Array     # i32 scalar, -1 = none (liveness metric)
     msg_count: jax.Array       # i32 scalar: delivered messages (tester.rs:147-149)
     snap_install_count: jax.Array  # i32 scalar: snapshot installs (2D metric)
+    # --- on-device metrics plane (ISSUE 10; shapes from config.metrics_dims
+    # — ALL ZERO-SIZE with cfg.metrics off, so the metrics-off state carries
+    # zero extra bytes and every metrics-off program is untouched) ---
+    log_tick: jax.Array        # i32 [N, CAP]: submit stamp of each live log
+    #                            entry — the tick a RAFT-INJECTED client
+    #                            command was first appended at its leader.
+    #                            Replicated with the entry at AE delivery;
+    #                            0 for leader no-ops and for every service-
+    #                            layer entry (kv/shardkv stamp their clerks
+    #                            instead and fold at clerk-ack), so the
+    #                            shadow fold's stamp > 0 mask counts each
+    #                            injected command exactly once
+    shadow_sub: jax.Array      # i32 [CAP] per-TICK scratch: submit stamps of
+    #                            the entries the durability shadow recorded
+    #                            THIS tick (0 = lane not recorded / not a
+    #                            stamped client op). Reset every tick — the
+    #                            flight recorder snapshots it, which is what
+    #                            makes host-recomputed latencies exact
+    lat_hist: jax.Array        # i32 [HIST_BUCKETS]: submit->ack latency
+    #                            histogram, fixed log-spaced buckets
+    #                            (metrics.py layout); raft layer folds at
+    #                            commit (shadow append), service layers at
+    #                            clerk ack — merged across lanes/shards by
+    #                            plain addition
+    ev_counts: jax.Array       # i32 [len(METRIC_EVENTS)]: cumulative
+    #                            per-lane liveness-event counters in
+    #                            config.METRIC_EVENTS order
 
 
 def durable_after_append(s: ClusterState, new_len: jax.Array) -> jax.Array:
@@ -207,6 +235,7 @@ def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
     if kn is None:
         kn = cfg.knobs()
     n, cap = cfg.n_nodes, cfg.log_cap
+    hb, evn, mcap = metrics_dims(cfg)
     zn = jnp.zeros((n,), I32)
     znn = jnp.zeros((n, n), I32)
     timer = jax.random.randint(
@@ -256,6 +285,10 @@ def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
         first_leader_tick=jnp.asarray(-1, I32),
         msg_count=jnp.asarray(0, I32),
         snap_install_count=jnp.asarray(0, I32),
+        log_tick=jnp.zeros((n, mcap), I32),
+        shadow_sub=jnp.zeros((mcap,), I32),
+        lat_hist=jnp.zeros((hb,), I32),
+        ev_counts=jnp.zeros((evn,), I32),
     )
 
 
@@ -307,6 +340,8 @@ class PackedSpec(NamedTuple):
     cmd: object         # dtype of log_val/shadow_val payloads
     noop_code: int      # the cmd dtype's reserved encoding of NOOP_CMD
     tick_signed: object  # first_violation_tick / first_leader_tick (-1 ok)
+    event: object       # dtype of the ev_counts liveness-counter row
+    #                     (bound: packed_bounds.event = n_nodes * T)
 
 
 def _uint_for(bound: int):
@@ -336,6 +371,7 @@ def packed_spec(cfg: SimConfig) -> PackedSpec:
         cmd=cmd_dt,
         noop_code=int(np.iinfo(cmd_dt).max),
         tick_signed=_sint_for(b.tick),
+        event=_uint_for(b.event),
     )
 
 
@@ -397,6 +433,17 @@ class PackedClusterState(NamedTuple):
     first_leader_tick: jax.Array     # tick_signed
     msg_count: jax.Array            # i32 cumulative counter
     snap_install_count: jax.Array   # i32
+    # --- metrics plane (ISSUE 10; zero-size with cfg.metrics off) ---
+    log_tick: jax.Array             # tick dtype: per-entry submit stamps
+    shadow_sub: jax.Array           # tick dtype: this-tick shadow stamps
+    lat_hist: jax.Array             # index dtype: bucket counts — on the
+    #                                 packed (raft) path each bucket counts
+    #                                 committed injected commands, bounded
+    #                                 by the shadow length's index bound;
+    #                                 service layers can exceed it but
+    #                                 never pack (their carries are wide)
+    ev_counts: jax.Array            # event dtype (narrow row; see
+    #                                 packed_bounds.event)
 
 
 def _bit_weights(n: int) -> jax.Array:
@@ -492,6 +539,10 @@ def pack_state(cfg: SimConfig, s: ClusterState) -> PackedClusterState:
         first_leader_tick=s.first_leader_tick.astype(sp.tick_signed),
         msg_count=s.msg_count,
         snap_install_count=s.snap_install_count,
+        log_tick=s.log_tick.astype(sp.tick),
+        shadow_sub=s.shadow_sub.astype(sp.tick),
+        lat_hist=s.lat_hist.astype(sp.index),
+        ev_counts=s.ev_counts.astype(sp.event),
     )
 
 
@@ -567,6 +618,10 @@ def unpack_state(cfg: SimConfig, p: PackedClusterState) -> ClusterState:
         first_leader_tick=p.first_leader_tick.astype(I32),
         msg_count=p.msg_count,
         snap_install_count=p.snap_install_count,
+        log_tick=p.log_tick.astype(I32),
+        shadow_sub=p.shadow_sub.astype(I32),
+        lat_hist=p.lat_hist.astype(I32),
+        ev_counts=p.ev_counts.astype(I32),
     )
 
 

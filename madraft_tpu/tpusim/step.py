@@ -79,6 +79,7 @@ from madraft_tpu.tpusim.config import (
     VIOLATION_LOG_MATCHING,
     VIOLATION_PREFIX_DIVERGE,
 )
+from madraft_tpu.tpusim.metrics import fold_latencies
 from madraft_tpu.tpusim.state import (
     ClusterState,
     I32,
@@ -239,6 +240,11 @@ def step_cluster(
     if kn is None:  # single-config callers: bake the knobs as constants
         kn = cfg.knobs()
     n, cap, ae_max = cfg.n_nodes, cfg.log_cap, cfg.ae_max
+    # metrics plane (ISSUE 10): pre-tick baselines for the per-lane event
+    # counters. Captured before the suffix-loss rollback below, so a bump
+    # is counted NET of any rollback this tick (a crash-lowered term that
+    # climbs back to its old value is not a bump).
+    term0, commit0 = s.term, s.commit
     t = s.tick + 1  # messages sent at tick t-1 with delay 1 arrive now
     key = jax.random.fold_in(cluster_key, t)
     blk = _DrawBlock(jax.random.fold_in(key, _S_STEP_BLOCK), _block_total(n))
@@ -342,6 +348,7 @@ def step_cluster(
 
     term, voted_for = s.term, s.voted_for
     log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
+    log_tick = s.log_tick  # metrics submit stamps ride with the log
     base, snap_term, prefix_hash = s.base, s.snap_term, s.prefix_hash
     durable_len = s.durable_len
     durable_term, durable_voted_for = s.durable_term, s.durable_voted_for
@@ -395,7 +402,8 @@ def step_cluster(
     stale = rv_rsp_t <= t  # includes this tick's processed/dropped slots
     rv_rsp_t = jnp.where(defer, t + 1, jnp.where(stale, 0, rv_rsp_t))
     got = jnp.any(pick, axis=1)
-    delivered += jnp.sum(pick, dtype=I32)
+    d_rv_rsp = jnp.sum(pick, dtype=I32)
+    delivered += d_rv_rsp
     mterm = picked(pick, rv_rsp_term)
     higher = got & (mterm > term)
     term = jnp.where(higher, mterm, term)
@@ -412,7 +420,8 @@ def step_cluster(
     stale = ae_rsp_t <= t
     ae_rsp_t = jnp.where(defer, t + 1, jnp.where(stale, 0, ae_rsp_t))
     got = jnp.any(pick, axis=1)
-    delivered += jnp.sum(pick, dtype=I32)
+    d_ae_rsp = jnp.sum(pick, dtype=I32)
+    delivered += d_ae_rsp
     mterm = picked(pick, ae_rsp_term)
     higher = got & (mterm > term)
     term = jnp.where(higher, mterm, term)
@@ -447,7 +456,8 @@ def step_cluster(
     sn_req_t = jnp.where((s.sn_req_t == t) & ~defer, 0, s.sn_req_t)
     sn_req_t = jnp.where(defer, t + 1, sn_req_t)
     got = jnp.any(pick, axis=1)
-    delivered += jnp.sum(pick, dtype=I32)
+    d_sn = jnp.sum(pick, dtype=I32)
+    delivered += d_sn
     mterm = picked(pick, s.sn_req_term)
     higher = got & (mterm > term)
     term = jnp.where(higher, mterm, term)
@@ -498,7 +508,8 @@ def step_cluster(
     rv_req_t = jnp.where((s.rv_req_t == t) & ~defer, 0, s.rv_req_t)
     rv_req_t = jnp.where(defer, t + 1, rv_req_t)
     got = jnp.any(pick, axis=1)
-    delivered += jnp.sum(pick, dtype=I32)
+    d_rv_req = jnp.sum(pick, dtype=I32)
+    delivered += d_rv_req
     mterm = picked(pick, s.rv_req_term)
     higher = got & (mterm > term)
     term = jnp.where(higher, mterm, term)
@@ -557,7 +568,8 @@ def step_cluster(
     ae_req_t = jnp.where((s.ae_req_t == t) & ~defer, 0, s.ae_req_t)
     ae_req_t = jnp.where(defer, t + 1, ae_req_t)
     got = jnp.any(pick, axis=1)
-    delivered += jnp.sum(pick, dtype=I32)
+    d_ae_req = jnp.sum(pick, dtype=I32)
+    delivered += d_ae_req
     mterm = picked(pick, s.ae_req_term)
     higher = got & (mterm > term)
     term = jnp.where(higher, mterm, term)
@@ -649,6 +661,19 @@ def step_cluster(
     log_val = jnp.where(
         any_hit, jnp.sum(jnp.where(hit, ent_v[..., None], 0), axis=1), log_val
     )
+    if cfg.metrics:
+        # the submit stamp replicates WITH the entry (read-at-delivery from
+        # the sender's live stamp ring, same one-hot as the payload), so any
+        # copy of an injected command carries its original leader-append
+        # tick — what the commit-latency fold below reads
+        plog_s = jnp.sum(
+            jnp.where(pick[:, :, None], log_tick[None, :, :], 0), axis=1
+        )
+        ent_s = jnp.sum(jnp.where(slot_oh, plog_s[:, None, :], 0), axis=-1)
+        log_tick = jnp.where(
+            any_hit, jnp.sum(jnp.where(hit, ent_s[..., None], 0), axis=1),
+            log_tick,
+        )
     batch_end = jnp.minimum(prev + nent, base + cap)  # ring overflow: drop tail
     # Conflict => truncate to the rewritten batch; otherwise never shrink
     # (a heartbeat must not drop entries a newer AE already appended).
@@ -725,6 +750,10 @@ def step_cluster(
     )
     log_term = jnp.where(nop_hit, term[:, None], log_term)
     log_val = jnp.where(nop_hit, NOOP_CMD, log_val)
+    if cfg.metrics:
+        # a no-op is not a client op: stamp 0 so the latency fold skips it
+        # (and so a stale stamp from an overwritten entry cannot leak in)
+        log_tick = jnp.where(nop_hit, 0, log_tick)
     log_len = jnp.where(nop, log_len + 1, log_len)
     # leader appends persist at append (start() -> persist()): the eye row
     # of the commit count below reads log_len, so it must be durable. The
@@ -768,6 +797,9 @@ def step_cluster(
     inj_hit = inject[:, None] & (lane == _slot(log_len + 1, cap)[:, None])
     log_term = jnp.where(inj_hit, term[:, None], log_term)
     log_val = jnp.where(inj_hit, cmd_val[:, None], log_val)
+    if cfg.metrics:
+        # the submit stamp: the tick this client command entered the system
+        log_tick = jnp.where(inj_hit, t, log_tick)
     log_len = jnp.where(inject, log_len + 1, log_len)
     durable_len = jnp.where(inject, log_len, durable_len)  # start()->persist
     next_cmd = s.next_cmd + jnp.any(inject).astype(I32)
@@ -885,6 +917,11 @@ def step_cluster(
         jnp.where(slide, _entry_mix(s.shadow_term, s.shadow_val, old_abs), 0)
     )
     sh_abs = _lane_abs(shadow_base, cap)  # [cap]
+    # metrics: this tick's shadow-record stamps (a per-tick SCRATCH, reset
+    # every tick — state.py shadow_sub). A lane goes nonzero exactly when a
+    # stamped client entry is recorded below, so "stamp > 0" is both the
+    # device fold mask and the flight recorder's exact host-recompute mask.
+    shadow_sub = (jnp.zeros((cap,), I32) if cfg.metrics else s.shadow_sub)
     for i in range(n):
         c = commit[i]
         agree = sh_abs == abs_arr[i]  # lane holds the same index in both rings
@@ -894,7 +931,17 @@ def step_cluster(
         new = agree & (sh_abs > shadow_len) & (sh_abs <= c)
         shadow_term = jnp.where(new, log_term[i], shadow_term)
         shadow_val = jnp.where(new, log_val[i], shadow_val)
+        if cfg.metrics:
+            shadow_sub = jnp.where(new, log_tick[i], shadow_sub)
         shadow_len = jnp.maximum(shadow_len, c)
+    # Commit-latency fold (ISSUE 10): an injected command's ack is its
+    # commit — the tick the durability shadow first records it. Latency =
+    # record tick - submit stamp; no-ops and service-layer entries carry
+    # stamp 0 and are skipped (service layers fold their own clerk-ack
+    # latencies instead, kv.py/shardkv.py).
+    lat_hist = s.lat_hist
+    if cfg.metrics:
+        lat_hist = fold_latencies(lat_hist, t - shadow_sub, shadow_sub > 0)
 
     # Prefix durability (the long-range extension of the shadow oracle, which
     # only sees the last `cap` committed entries; the round-1 advisory gap):
@@ -961,6 +1008,21 @@ def step_cluster(
     durable_term = jnp.where(do_fsync, term, durable_term)
     durable_voted_for = jnp.where(do_fsync, voted_for, durable_voted_for)
 
+    # ------------------------------------------------ metrics: event counters
+    # One increment per node per event per tick (config.METRIC_EVENTS order;
+    # the per-type delivery counts are the same exact quantities the trace
+    # module derives, so their sum equals the msg_count delta — test-pinned).
+    ev_counts = s.ev_counts
+    if cfg.metrics:
+        ev_counts = ev_counts + jnp.stack([
+            jnp.sum(win, dtype=I32),                  # elections_won
+            jnp.sum(term > term0, dtype=I32),         # term_bumps
+            jnp.sum(crash, dtype=I32),                # crashes
+            jnp.sum(restart, dtype=I32),              # restarts
+            d_rv_req, d_rv_rsp, d_ae_req, d_ae_rsp, d_sn,
+            jnp.sum(commit > commit0, dtype=I32),     # commit_advances
+        ])
+
     return ClusterState(
         tick=t,
         term=term, voted_for=voted_for, role=role, timer=timer, hb=hb, alive=alive,
@@ -990,4 +1052,8 @@ def step_cluster(
         first_leader_tick=first_leader_tick,
         msg_count=s.msg_count + delivered,
         snap_install_count=snap_install_count,
+        log_tick=log_tick,
+        shadow_sub=shadow_sub,
+        lat_hist=lat_hist,
+        ev_counts=ev_counts,
     )

@@ -86,6 +86,14 @@ class TickRecord(NamedTuple):
     shadow_len: jax.Array      # committed entries ever (durability shadow)
     msg_count: jax.Array       # cumulative delivered messages
     violations: jax.Array      # sticky oracle bitmask
+    # --- metrics plane (ISSUE 10; zero-size with cfg.metrics off) ---
+    shadow_sub: jax.Array      # [CAP] THIS tick's shadow-record submit
+    #                            stamps (0 = lane not recorded / no stamp):
+    #                            t - stamp over nonzero lanes is the exact
+    #                            latency set the device folded this tick —
+    #                            what the host cross-check recomputes
+    lat_hist: jax.Array        # [HIST_BUCKETS] cumulative device histogram
+    ev_counts: jax.Array       # [len(METRIC_EVENTS)] cumulative counters
 
 
 def _pack_rows(mat: jax.Array) -> jax.Array:
@@ -130,6 +138,8 @@ def _record(prev: ClusterState, nxt: ClusterState) -> TickRecord:
         snap_installed_len=nxt.snap_installed_len,
         shadow_len=nxt.shadow_len, msg_count=nxt.msg_count,
         violations=nxt.violations,
+        shadow_sub=nxt.shadow_sub, lat_hist=nxt.lat_hist,
+        ev_counts=nxt.ev_counts,
     )
 
 
@@ -269,10 +279,20 @@ def decode_events(rec: TickRecord) -> list:
                 })
         shadow = int(rec.shadow_len[ti])
         if shadow > prev_shadow:
-            events.append({
+            ev = {
                 "tick": t, "event": "commit_advance",
                 "committed": shadow, "delta": shadow - prev_shadow,
-            })
+            }
+            if rec.shadow_sub.shape[-1]:
+                # metrics trace: the commit IS the ack — attach the
+                # latencies of the client entries recorded this tick,
+                # host-decoded from the per-tick submit stamps (no-ops and
+                # unstamped service entries carry 0 and are skipped)
+                subs = rec.shadow_sub[ti]
+                ev["latencies"] = sorted(
+                    int(t - s) for s in subs[subs > 0]
+                )
+            events.append(ev)
         viol = int(rec.violations[ti])
         new_bits = viol & ~prev_viol
         if new_bits:
@@ -372,4 +392,38 @@ def chrome_trace(
                 "snap": int(rec.snap_delivered[ti]),
             },
         })
+    # metrics trace (ISSUE 10): per-tick liveness-event counter tracks from
+    # the cumulative ev_counts rows (deltas — the spike view that makes a
+    # latency-tail bucket's CAUSE visible in the same timeline), plus a
+    # commit-latency track (max latency folded per tick) so a tail op shows
+    # as a spike at its ack tick.
+    if rec.ev_counts.shape[-1]:
+        from madraft_tpu.tpusim.config import METRIC_EVENTS
+
+        ev = np.asarray(rec.ev_counts, np.int64)
+        deltas = np.diff(np.concatenate([np.zeros((1, ev.shape[1]),
+                                                  np.int64), ev], axis=0),
+                         axis=0)
+        idx = {name: k for k, name in enumerate(METRIC_EVENTS)}
+        for ti in range(T):
+            ts = (ti + 1) * us
+            out.append({
+                "name": "liveness", "ph": "C", "pid": 0, "ts": ts,
+                "args": {
+                    "elections_won": int(deltas[ti, idx["elections_won"]]),
+                    "term_bumps": int(deltas[ti, idx["term_bumps"]]),
+                    "crashes": int(deltas[ti, idx["crashes"]]),
+                    "restarts": int(deltas[ti, idx["restarts"]]),
+                    "commit_advances": int(
+                        deltas[ti, idx["commit_advances"]]
+                    ),
+                },
+            })
+            subs = rec.shadow_sub[ti]
+            lat = (ti + 1) - subs[subs > 0]
+            out.append({
+                "name": "commit_latency_ticks", "ph": "C", "pid": 0,
+                "ts": ts,
+                "args": {"max": int(lat.max()) if lat.size else 0},
+            })
     return {"traceEvents": out, "displayTimeUnit": "ms"}

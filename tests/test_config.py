@@ -25,8 +25,10 @@ SIM_FOLDED = {
 
 
 def test_simconfig_fields_all_reach_the_program():
-    # static_key's fields (max_lane_ticks shapes the packed dtypes)
-    static = {"n_nodes", "log_cap", "ae_max", "bug", "max_lane_ticks"}
+    # static_key's fields (max_lane_ticks shapes the packed dtypes;
+    # metrics shapes the ISSUE-10 metric arrays — zero-size when off)
+    static = {"n_nodes", "log_cap", "ae_max", "bug", "max_lane_ticks",
+              "metrics"}
     knob_names = set(Knobs._fields)
     for f in dataclasses.fields(SimConfig):
         if f.name in SIM_DOC_ONLY or f.name in static:

@@ -1,0 +1,300 @@
+"""On-device metrics plane (ISSUE 10): metrics-off trajectories are
+bit-identical (no PRNG draws added), host-recomputed latencies from the
+flight-recorder stamp stream land in exactly the device histogram's
+buckets, the delivery counters sum to msg_count, clerk-ack fold counts are
+exact against the acked-op totals, the pool carries histograms through
+rows/summary at any device count and layout, and the stats verb renders
+any report stream."""
+
+import contextlib
+import io
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from madraft_tpu.__main__ import main
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim import metrics as M
+from madraft_tpu.tpusim.config import (
+    HIST_BUCKETS,
+    METRIC_EVENTS,
+    storm_profiles,
+)
+from madraft_tpu.tpusim.engine import fuzz, replay_cluster, run_pool
+from madraft_tpu.tpusim.trace import replay_cluster_traced
+
+STORM = SimConfig(
+    n_nodes=5, p_client_cmd=0.2, loss_prob=0.1, p_crash=0.01, p_restart=0.2,
+    max_dead=2, p_repartition=0.02, p_heal=0.05,
+)
+MSTORM = STORM.replace(metrics=True)
+DURABILITY = storm_profiles()["durability"][0]
+
+
+def run_cli(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(argv)
+    return rc, buf.getvalue()
+
+
+def test_metrics_off_trajectories_bit_identical():
+    # the plane adds NO PRNG draws: a metrics run IS the metrics-off run
+    # plus observation — violations, commits, and deliveries must agree
+    r_off = fuzz(STORM, 7, 8, 128)
+    r_on = fuzz(MSTORM, 7, 8, 128)
+    for f in ("violations", "first_violation_tick", "committed",
+              "msg_count"):
+        assert np.array_equal(getattr(r_off, f), getattr(r_on, f)), f
+    assert r_off.lat_hist is None and r_on.lat_hist is not None
+    assert r_on.lat_hist.shape == (8, HIST_BUCKETS)
+
+
+def test_traced_replay_cross_check_histogram():
+    # THE cross-check satellite: recompute every latency on the host from
+    # the flight recorder's per-tick submit-stamp stream (t - stamp over
+    # nonzero lanes) and bucket it with an INDEPENDENT implementation
+    # (np.searchsorted) — the totals must land in exactly the buckets the
+    # on-device fold reported for the same (seed, cluster id)
+    final, rec = replay_cluster_traced(MSTORM, 7, 3, 300)
+    untraced = replay_cluster(MSTORM, 7, 3, 300)
+    assert np.array_equal(np.asarray(final.lat_hist),
+                          np.asarray(untraced.lat_hist))
+    assert np.array_equal(np.asarray(final.ev_counts),
+                          np.asarray(untraced.ev_counts))
+    host = np.zeros(HIST_BUCKETS, np.int64)
+    T = rec.shadow_sub.shape[0]
+    for ti in range(T):
+        subs = rec.shadow_sub[ti]
+        lats = (ti + 1) - subs[subs > 0]
+        assert (lats >= 0).all()
+        for b in M.host_bucket(lats):
+            host[b] += 1
+    assert host.sum() > 0, "storm committed no injected commands"
+    np.testing.assert_array_equal(host, np.asarray(final.lat_hist))
+    # the cumulative trace row agrees with the final state too
+    np.testing.assert_array_equal(np.asarray(rec.lat_hist[-1]),
+                                  np.asarray(final.lat_hist))
+
+
+def test_delivery_counters_sum_to_msg_count():
+    st = replay_cluster(MSTORM, 7, 3, 300)
+    ev = np.asarray(st.ev_counts)
+    names = list(METRIC_EVENTS)
+    deliv = sum(ev[names.index(k)] for k in
+                ("rv_req_delivered", "rv_rsp_delivered", "ae_req_delivered",
+                 "ae_rsp_delivered", "snap_delivered"))
+    assert deliv == int(st.msg_count)
+    assert ev[names.index("elections_won")] >= 1
+    assert ev[names.index("commit_advances")] >= 1
+    # crashes/restarts come from the same Bernoulli stream the step always
+    # drew; the storm profile crashes, so the counters must see it
+    assert ev[names.index("crashes")] >= 1
+    # every latency the histogram folded is a committed injected command —
+    # bounded by the committed-entry total
+    assert 0 < np.asarray(st.lat_hist).sum() <= int(st.shadow_len)
+
+
+def test_kv_clerk_ack_fold_is_exact():
+    from madraft_tpu.tpusim.kv import KvConfig, kv_fuzz
+
+    cfg = MSTORM.replace(
+        p_client_cmd=0.0, compact_at_commit=False, compact_every=16,
+        p_crash=0.0, max_dead=0,
+    )
+    rep = kv_fuzz(cfg, KvConfig(p_get=0.3, p_put=0.2), 5, 8, 200)
+    # clerks serialize seqs, so acked_ops IS the number of ack events, and
+    # every ack folded exactly one latency
+    assert rep.lat_hist.sum() == rep.acked_ops.sum() > 0
+    assert rep.ev_counts.shape == (8, len(METRIC_EVENTS))
+    # service entries carry stamp 0: the raft-layer commit fold must not
+    # double-count clerk ops (each op folds once, at its clerk ack)
+    per_cluster = rep.lat_hist.sum(axis=1)
+    np.testing.assert_array_equal(per_cluster, rep.acked_ops)
+
+
+def test_shardkv_clerk_ack_fold_is_exact():
+    from madraft_tpu.tpusim.shardkv import ShardKvConfig, shardkv_fuzz
+
+    cfg = SimConfig(
+        n_nodes=3, p_client_cmd=0.0, compact_at_commit=False, log_cap=64,
+        compact_every=16, loss_prob=0.05, metrics=True,
+    )
+    rep = shardkv_fuzz(cfg, ShardKvConfig(), 3, 2, 320)
+    assert rep.lat_hist is not None
+    np.testing.assert_array_equal(rep.lat_hist.sum(axis=1), rep.acked_ops)
+    assert rep.acked_ops.sum() > 0
+    assert rep.ev_counts.shape[-1] == len(METRIC_EVENTS)
+
+
+def _pool_rows_and_summary(devices=None, pack_states=None, seed=3):
+    cfg = DURABILITY.replace(bug="ack_before_fsync", metrics=True)
+    rows = []
+    s = run_pool(cfg, seed, 16, 100, chunk_ticks=50, budget_ticks=300,
+                 devices=devices, on_retired=rows.append,
+                 pack_states=pack_states)
+    return rows, s
+
+
+def test_pool_metrics_rows_and_summary():
+    rows, s = _pool_rows_and_summary()
+    lat = s["latency"]
+    assert lat["ops"] > 0 and sum(lat["hist"]) == lat["ops"]
+    assert s["events"]["commit_advances"] > 0
+    assert all("latency_hist" in r and "events" in r for r in rows)
+    # summary latency == retired rows + the last harvest's in-flight lanes;
+    # at this budget every lane retires at the horizon, so the row rows
+    # alone must not exceed the merged total
+    row_sum = np.sum([r["latency_hist"] for r in rows], axis=0)
+    assert (row_sum <= np.asarray(lat["hist"])).all()
+
+
+def test_pool_metrics_bit_identical_across_layouts():
+    rows_w, s_w = _pool_rows_and_summary(pack_states=False)
+    rows_p, s_p = _pool_rows_and_summary(pack_states=True)
+    assert s_w["state_layout"] == "wide" and s_p["state_layout"] == "packed"
+    assert s_w["latency"] == s_p["latency"]
+    assert s_w["events"] == s_p["events"]
+    assert [r["latency_hist"] for r in rows_w] == \
+        [r["latency_hist"] for r in rows_p]
+
+
+def test_pool_metrics_device_count_invariant():
+    # the ISSUE-10 extension of the PR-7 invariance contract: the SUMMED
+    # histograms (and counters) of a fixed budget agree at any device count
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    rows1, s1 = _pool_rows_and_summary(devices=1)
+    rows2, s2 = _pool_rows_and_summary(devices=2)
+    assert s1["latency"] == s2["latency"]
+    assert s1["events"] == s2["events"]
+    key = lambda rows: sorted(  # noqa: E731
+        (r["cluster_id"], tuple(r["latency_hist"])) for r in rows
+    )
+    assert key(rows1) == key(rows2)
+
+
+def test_quantile_decode_and_bucket_layout():
+    # layout: bucket 0 = [0,1], k >= 1 = [2^k, 2^(k+1)-1], last open-ended
+    assert M.bucket_bounds(0) == (0, 1)
+    assert M.bucket_bounds(3) == (8, 15)
+    assert M.bucket_bounds(HIST_BUCKETS - 1)[1] is None
+    assert list(M.host_bucket(np.asarray([0, 1, 2, 3, 4, 1 << 20]))) == \
+        [0, 0, 1, 1, 2, HIST_BUCKETS - 1]
+    # device fold == host buckets on a latency sweep
+    lats = np.arange(0, 500, dtype=np.int32)
+    dev = np.asarray(M.fold_latencies(
+        np.zeros(HIST_BUCKETS, np.int32), lats, np.ones_like(lats, bool)
+    ))
+    host = np.bincount(M.host_bucket(lats), minlength=HIST_BUCKETS)
+    np.testing.assert_array_equal(dev, host)
+    # quantile = upper edge of the quantile's bucket
+    h = np.zeros(HIST_BUCKETS, np.int64)
+    h[2] = 99
+    h[5] = 1
+    assert M.quantile_from_hist(h, 0.5) == 7
+    assert M.quantile_from_hist(h, 0.99) == 7
+    assert M.quantile_from_hist(h, 0.999) == 63
+    assert M.quantile_from_hist(np.zeros(HIST_BUCKETS), 0.5) is None
+    # merging is plain addition of hist rows — the property every surface
+    # (pool summary, stats verb, cross-file sums) relies on
+    a = M.latency_summary(h)
+    merged = M.latency_summary(np.asarray(a["hist"]) + np.asarray(a["hist"]))
+    assert merged["ops"] == 2 * a["ops"]
+    assert merged["p99_ticks"] == a["p99_ticks"]
+
+
+def test_stats_summary_wins_rule_is_per_stream():
+    # a full pool stream (rows + summary) next to a rows-only grep from a
+    # DIFFERENT run: the summary suppresses only ITS OWN stream's rows —
+    # the rows-only file must still merge in full
+    from madraft_tpu.__main__ import _collect_stats
+
+    hist_a = [0] * HIST_BUCKETS
+    hist_a[2] = 5
+    pool_stream = [
+        json.dumps({"cluster_id": 0, "latency_hist": hist_a,
+                    "events": {"crashes": 1}}),
+        json.dumps({"lanes": 1, "latency": {"ops": 5, "hist": hist_a},
+                    "events": {"crashes": 1}}),
+    ]
+    hist_b = [0] * HIST_BUCKETS
+    hist_b[4] = 3
+    rows_only = [
+        json.dumps({"cluster_id": 9, "latency_hist": hist_b,
+                    "events": {"crashes": 2}}),
+    ]
+    hist, events, seen = _collect_stats([pool_stream, rows_only])
+    assert seen == 2  # the pool summary + the foreign row, not the pool row
+    assert hist[2] == 5 and hist[4] == 3
+    assert events[list(METRIC_EVENTS).index("crashes")] == 3
+    # an events-ONLY report (the ctrler layer: counters without latency
+    # stamps) must merge too, not read as "no metrics found"
+    ev_only = [json.dumps({"violating": 0, "events": {"crashes": 4}})]
+    hist, events, seen = _collect_stats([ev_only])
+    assert seen == 1 and hist.sum() == 0
+    assert events[list(METRIC_EVENTS).index("crashes")] == 4
+
+
+def test_explain_chrome_gains_liveness_counters(tmp_path):
+    from madraft_tpu.tpusim.trace import chrome_trace, decode_events
+
+    # 300 ticks on purpose: shares the traced program (scan length is a
+    # static cache key) with the cross-check test above
+    final, rec = replay_cluster_traced(MSTORM, 7, 3, 300)
+    events = decode_events(rec)
+    advances = [e for e in events if e["event"] == "commit_advance"]
+    assert advances and all("latencies" in e for e in advances)
+    total = sum(len(e["latencies"]) for e in advances)
+    assert total == int(np.asarray(final.lat_hist).sum())
+    doc = chrome_trace(rec, MSTORM.ms_per_tick, events)
+    counters = {e["name"] for e in doc["traceEvents"] if e["ph"] == "C"}
+    assert {"liveness", "commit_latency_ticks", "deliveries"} <= counters
+    live = [e for e in doc["traceEvents"]
+            if e["ph"] == "C" and e["name"] == "liveness"]
+    assert sum(e["args"]["commit_advances"] for e in live) == int(
+        np.asarray(final.ev_counts)[list(METRIC_EVENTS).index(
+            "commit_advances")]
+    )
+
+
+def test_service_cli_metrics_plumbing():
+    # shardkv-fuzz builds its SimConfig from scratch — the --metrics flag
+    # must be carried explicitly (a dropped flag silently reports nothing);
+    # ctrler-fuzz surfaces events WITHOUT a latency dict (its clerk carries
+    # no latency stamps yet — documented in CtrlerFuzzReport)
+    rc, out = run_cli(["shardkv-fuzz", "--clusters", "2", "--ticks", "160",
+                       "--metrics", "--nodes", "3"])
+    d = json.loads(out.strip().splitlines()[-1])
+    assert "latency" in d and d["latency"]["ops"] > 0, d.keys()
+    assert "events" in d
+    rc, out = run_cli(["ctrler-fuzz", "--clusters", "8", "--ticks", "128",
+                       "--metrics"])
+    d = json.loads(out.strip().splitlines()[-1])
+    assert "latency" not in d and "events" in d, d.keys()
+    assert d["events"]["elections_won"] > 0
+
+
+def test_fuzz_cli_report_and_stats_verb(tmp_path):
+    rc, out = run_cli([
+        "fuzz", "--clusters", "8", "--ticks", "128", "--storm", "--metrics",
+        "--seed", "7",
+    ])
+    rep = json.loads(out.strip().splitlines()[-1])
+    assert "latency" in rep and rep["latency"]["ops"] > 0
+    assert set(rep["events"]) == set(METRIC_EVENTS)
+    p = tmp_path / "rep.json"
+    p.write_text(out)
+    rc, rendered = run_cli(["stats", str(p)])
+    assert rc == 0
+    assert f"ops={rep['latency']['ops']}" in rendered
+    # a metrics-off report carries nothing to render: exit 2, say so
+    rc, out_off = run_cli([
+        "fuzz", "--clusters", "8", "--ticks", "64", "--seed", "7",
+    ])
+    assert "latency" not in json.loads(out_off.strip().splitlines()[-1])
+    p2 = tmp_path / "off.json"
+    p2.write_text(out_off)
+    assert run_cli(["stats", str(p2)])[0] == 2

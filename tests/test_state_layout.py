@@ -12,7 +12,7 @@ import pytest
 
 from madraft_tpu.tpusim import SimConfig
 from madraft_tpu.tpusim import state as st
-from madraft_tpu.tpusim.config import NOOP_CMD, packed_bounds
+from madraft_tpu.tpusim.config import NOOP_CMD, metrics_dims, packed_bounds
 from madraft_tpu.tpusim.engine import replay_cluster, run_pool
 
 STORM = SimConfig(
@@ -29,6 +29,7 @@ def _rand_state(cfg: SimConfig, rng: np.random.Generator,
     ``boundary``) every bound's exact maximum, so the round-trip test fails
     loudly the day a width stops holding its declared bound."""
     n, cap = cfg.n_nodes, cfg.log_cap
+    hb, evn, mcap = metrics_dims(cfg)
     b = packed_bounds(cfg)
     i32 = lambda x: jnp.asarray(x, jnp.int32)  # noqa: E731
 
@@ -119,6 +120,12 @@ def _rand_state(cfg: SimConfig, rng: np.random.Generator,
         first_leader_tick=neg1_tick(()),
         msg_count=i32(int(rng.integers(0, 2**31))),
         snap_install_count=i32(int(rng.integers(0, 2**31))),
+        # metrics plane (ISSUE 10): zero-size with metrics off; stamps are
+        # tick-bounded, hist counts index-bounded, ev counts event-bounded
+        log_tick=ints(b.tick, (n, mcap)),
+        shadow_sub=ints(b.tick, (mcap,)),
+        lat_hist=ints(b.index, (hb,)),
+        ev_counts=ints(b.event, (evn,)),
     )
 
 
@@ -135,6 +142,7 @@ def _assert_states_equal(a: st.ClusterState, b: st.ClusterState):
     SimConfig(n_nodes=3, log_cap=16, ae_max=2, compact_every=4),
     SimConfig(n_nodes=16, log_cap=16, compact_every=4),  # widest word
     SimConfig(max_lane_ticks=1 << 18),                # u32-index regime
+    STORM.replace(metrics=True),          # ISSUE 10: metric rows populated
 ])
 def test_pack_roundtrip_randomized_every_field(cfg):
     rng = np.random.default_rng(7)
@@ -162,6 +170,7 @@ def test_widths_pin_to_config_bounds():
         assert sp.noop_code > b.cmd, "NOOP sentinel must sit above any cmd"
         assert np.iinfo(sp.tick_signed).max >= b.tick  # -1 sentinel fields
         assert b.rel_stamp <= np.iinfo(np.uint8).max - 1
+        assert np.iinfo(sp.event).max >= b.event  # ISSUE 10 counter rows
     # defaults: 5 nodes / 4096 ticks fit u16 everywhere
     sp = st.packed_spec(STORM.static_key())
     assert sp.term == jnp.uint16 and sp.index == jnp.uint16
