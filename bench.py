@@ -517,6 +517,97 @@ def bench_state_footprint() -> dict:
     }
 
 
+def bench_service_footprint(n_deployments: int, n_ticks: int) -> dict:
+    """Service-layer resident-carry footprint, wide vs packed (ISSUE 11):
+    bytes per deployment for the kv / ctrler / shardkv stacks at their
+    bench shapes (live buffers, never a schema estimate), plus the
+    PACK-TAX A/B on the heaviest stack — shardkv group-cluster-steps/s at
+    equal shape on the wide vs packed carry. The packed leg shares its
+    compiled program with bench_shardkv (same static shapes), so the row
+    mostly pays one extra wide-leg compile. On CPU the packed path pays
+    the pack/unpack casts with no HBM to win back, so the ratio is the
+    regression bound PERF.md round 11 records (<= 10%, PR 9's measured
+    tax); the bytes column is the on-chip story queued behind the tunnel.
+    MADTPU_BENCH_FUSED=1 adds the cfg.fuse_packed_step leg (its own
+    compiled program — the scan-level fusion audit's measurement surface,
+    recorded in PERF.md rather than paid on every bench run)."""
+    import os
+
+    from madraft_tpu.tpusim import state as stmod
+    from madraft_tpu.tpusim.ctrler import (
+        CtrlerConfig,
+        init_ctrler_cluster,
+        pack_ctrler_state,
+    )
+    from madraft_tpu.tpusim.kv import KvConfig, init_kv_cluster, pack_kv_state
+    from madraft_tpu.tpusim.shardkv import (
+        ShardKvConfig,
+        init_shardkv_cluster,
+        make_shardkv_fuzz_fn,
+        pack_shardkv_state,
+    )
+
+    kv_cfg = flagship_config().replace(
+        p_client_cmd=0.0, compact_at_commit=False, compact_every=16
+    )
+    kv_kcfg = KvConfig(p_get=0.3)
+    ctl_cfg = flagship_config().replace(
+        p_client_cmd=0.0, compact_at_commit=False, log_cap=32, compact_every=8
+    )
+    ctl_kcfg = CtrlerConfig()
+    skv_cfg = SimConfig(
+        n_nodes=3, p_client_cmd=0.0, compact_at_commit=False, log_cap=64,
+        compact_every=16, loss_prob=0.05,
+    )
+    skv_kcfg = ShardKvConfig()
+
+    key = jax.random.PRNGKey(0)
+    rows = {}
+    for name, wide_s, packed_s in (
+        ("kv", init_kv_cluster(kv_cfg, kv_kcfg, key),
+         lambda s: pack_kv_state(kv_cfg, kv_kcfg, s)),
+        ("ctrler", init_ctrler_cluster(ctl_cfg, ctl_kcfg, key),
+         lambda s: pack_ctrler_state(ctl_cfg, ctl_kcfg, s)),
+        ("shardkv", init_shardkv_cluster(skv_cfg, skv_kcfg, key),
+         lambda s: pack_shardkv_state(skv_cfg, skv_kcfg, s)),
+    ):
+        w = stmod.tree_bytes(wide_s)
+        p = stmod.tree_bytes(packed_s(wide_s))
+        rows[name] = {
+            "wide_bytes_per_deployment": w,
+            "packed_bytes_per_deployment": p,
+            "reduction": round(w / p, 3),
+        }
+
+    sync = lambda s: np.asarray(s.violations)  # noqa: E731
+    legs = {"wide": dict(pack_states=False), "packed": dict(pack_states=True)}
+    if os.environ.get("MADTPU_BENCH_FUSED"):
+        legs["fused"] = dict(pack_states=True, fused=True)
+    for leg, opts in legs.items():
+        cfg = skv_cfg.replace(fuse_packed_step=opts.pop("fused", False))
+        fn = make_shardkv_fuzz_fn(cfg, skv_kcfg, n_deployments, n_ticks,
+                                  **opts)
+        finish = _compile_region(fn, sync)
+        best, runs, spread, _ = _timed(lambda: fn(12345), sync)
+        rows["shardkv"].update({
+            f"{leg}_cluster_steps_per_sec": round(
+                n_deployments * n_ticks * skv_kcfg.n_groups / best, 1
+            ),
+            f"{leg}_best_wall_s": round(best, 3),
+            f"{leg}_run_spread": round(spread, 3),
+            f"{leg}_compile_s": finish(best),
+        })
+    rows["shardkv"]["packed_steps_ratio"] = round(
+        rows["shardkv"]["packed_cluster_steps_per_sec"]
+        / rows["shardkv"]["wide_cluster_steps_per_sec"], 3
+    )
+    rows["shape"] = {
+        "n_deployments": n_deployments, "n_ticks": n_ticks,
+        "n_groups": skv_kcfg.n_groups,
+    }
+    return rows
+
+
 def bench_coverage(n_lanes: int, budget_ticks: int) -> dict:
     """Coverage-guided vs uniform-random A/B (ROADMAP item 3), two legs:
 
@@ -735,6 +826,12 @@ def main() -> None:
     # per-lane resident-state footprint, wide vs packed (ISSUE 9): tracks
     # the lanes-per-HBM trajectory from this round on
     footprint = bench_state_footprint()
+    # service-layer footprint + shardkv pack-tax A/B (ISSUE 11): bytes per
+    # deployment wide vs packed for kv/ctrler/shardkv, and group-cluster-
+    # steps/s at equal shape on both carries (same shapes as the shardkv
+    # row, so the packed leg shares its compiled program)
+    svc_footprint = bench_service_footprint(max(64, n_clusters // 16),
+                                            max(128, n_ticks // 4))
     steps_per_sec = raft.pop("steps_per_sec")
     doc = json.dumps(
             {
@@ -782,6 +879,10 @@ def main() -> None:
                     "coverage": covr,
                     "state_footprint_reduction": footprint["reduction"],
                     "state_footprint": footprint,
+                    "service_footprint_shardkv_reduction": svc_footprint[
+                        "shardkv"
+                    ]["reduction"],
+                    "service_footprint": svc_footprint,
                     # latency tail + the p99 regression gate (ISSUE 10)
                     "latency_p50_ticks": latency["latency_p50_ticks"],
                     "latency_p99_ticks": latency["latency_p99_ticks"],

@@ -237,6 +237,50 @@ print(f"metrics smoke: bug leg {lat['ops']} ops "
       f"{DURABILITY_P99_BOUND}")
 PY
 
+# service packed-state smoke (ISSUE 11): the kv/ctrler/shardkv fuzz verbs
+# carry their loop state in the packed SERVICE schemas at the default
+# shapes — each leg must report state_layout "packed" in its telemetry,
+# and the shardkv leg bounds bytes per DEPLOYMENT (the analogue of the
+# raft bytes_per_lane <= 2800 gate above): 12840 B measured at the
+# 3-node/3-group bench shape vs 23009 B wide (PERF.md round 11); the
+# 14000 ceiling keeps a later PR from silently re-widening a service
+# field. The kv/ctrler runs are clean (exit 0); packed-vs-wide report
+# bit-identity itself is pinned by tests/test_service_layout.py.
+MADTPU_PLATFORM=cpu python - <<'PY'
+import contextlib, io, json
+from madraft_tpu.__main__ import main
+
+SHARDKV_BYTES_PER_DEPLOYMENT_BOUND = 14000  # wide is 23009 at this shape
+
+def run(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(argv)
+    return rc, json.loads(buf.getvalue().strip().splitlines()[-1])
+
+rc, d = run(["kv-fuzz", "--clusters", "32", "--ticks", "128", "--seed", "3"])
+assert rc == 0, f"kv-fuzz exit {rc}"
+kv_tele = d["telemetry"]
+assert kv_tele["state_layout"] == "packed", kv_tele
+
+rc, d = run(["ctrler-fuzz", "--clusters", "32", "--ticks", "128",
+             "--seed", "3"])
+assert rc == 0, f"ctrler-fuzz exit {rc}"
+assert d["telemetry"]["state_layout"] == "packed", d["telemetry"]
+
+rc, d = run(["shardkv-fuzz", "--nodes", "3", "--clusters", "8",
+             "--ticks", "160", "--seed", "3"])
+assert rc == 0, f"shardkv-fuzz exit {rc}"
+tele = d["telemetry"]
+assert tele["state_layout"] == "packed", tele
+assert tele["bytes_per_lane"] <= SHARDKV_BYTES_PER_DEPLOYMENT_BOUND, (
+    f"packed shardkv carry re-widened: {tele['bytes_per_lane']} B/deployment"
+    f" > {SHARDKV_BYTES_PER_DEPLOYMENT_BOUND} (wide is 23009)"
+)
+print(f"service packed smoke: kv {kv_tele['bytes_per_lane']} B/lane, "
+      f"shardkv {tele['bytes_per_lane']} B/deployment, all legs packed")
+PY
+
 # sharded-pool smoke (ISSUE 7): the pod-scale lane-partitioned pool on the
 # 2-virtual-device CI config. The planted-bug leg must retire >= 1 violating
 # cluster and exit 1; the clean leg must retire everything at the horizon

@@ -232,8 +232,9 @@ def _report_json(rep, extra=None):
             out[f"{f}_mean"] = round(float(v.mean()), 2)
     # histograms/counters merge across clusters by plain addition —
     # latency_p50/p99 decode from the merged buckets (ISSUE 10). The two
-    # blocks are independent: the ctrler layer counts events but carries
-    # no clerk latency stamps, so its reports have events without latency.
+    # blocks are independent; since ISSUE 11 every clerk-bearing layer
+    # (kv, ctrler, shardkv) stamps submit->ack latency, so a --metrics
+    # report carries both.
     if getattr(rep, "lat_hist", None) is not None:
         from madraft_tpu.tpusim.metrics import latency_summary
 

@@ -31,12 +31,15 @@ from madraft_tpu.tpusim.kv import (
     KvConfig,
     KvFuzzReport,
     KvState,
+    PackedKvState,
     init_kv_cluster,
     kv_fuzz,
     kv_replay_cluster,
     kv_report,
     kv_step,
     make_kv_fuzz_fn,
+    pack_kv_state,
+    unpack_kv_state,
 )
 
 from madraft_tpu.tpusim.ctrler import (
@@ -47,25 +50,31 @@ from madraft_tpu.tpusim.ctrler import (
     CtrlerConfig,
     CtrlerFuzzReport,
     CtrlerState,
+    PackedCtrlerState,
     ctrler_fuzz,
     ctrler_replay_cluster,
     ctrler_report,
     ctrler_step,
     init_ctrler_cluster,
     make_ctrler_fuzz_fn,
+    pack_ctrler_state,
+    unpack_ctrler_state,
 )
 from madraft_tpu.tpusim.shardkv import (
     VIOLATION_SHARD_DIVERGE,
     VIOLATION_SHARD_OWNERSHIP,
     VIOLATION_SHARD_STORAGE,
+    PackedShardKvState,
     ShardKvConfig,
     ShardKvFuzzReport,
     ShardKvState,
     init_shardkv_cluster,
     make_shardkv_fuzz_fn,
+    pack_shardkv_state,
     shardkv_fuzz,
     shardkv_report,
     shardkv_step,
+    unpack_shardkv_state,
 )
 
 __all__ = [
@@ -117,4 +126,13 @@ __all__ = [
     "make_kv_fuzz_fn",
     "VIOLATION_EXACTLY_ONCE",
     "VIOLATION_KV_DIVERGE",
+    "PackedKvState",
+    "PackedCtrlerState",
+    "PackedShardKvState",
+    "pack_kv_state",
+    "unpack_kv_state",
+    "pack_ctrler_state",
+    "unpack_ctrler_state",
+    "pack_shardkv_state",
+    "unpack_shardkv_state",
 ]

@@ -59,6 +59,22 @@ class SimConfig:
     # draw) is bit-identical to the same run with metrics off.
     metrics: bool = False
 
+    # Packed-step fusion (ISSUE 11): compose pack∘step∘unpack PER FIELD
+    # GROUP instead of at the whole-state boundary. On the packed service
+    # carries (kv/ctrler/shardkv), the raft sub-tick then consumes and
+    # produces the PACKED raft group directly — the service tick reads a
+    # widened VIEW of only the raft fields it needs (XLA dead-code-
+    # eliminates the rest) and packs only the fields it writes, so the
+    # full wide raft pytree never materializes between the raft layer and
+    # the service apply machines (the HBM round-trip ROADMAP item 3
+    # names). STATIC on purpose, like `bug` and `metrics`: a fused run
+    # selects its own cached programs, so every existing program's HLO —
+    # and all golden guards — stay bit-identical with the flag off.
+    # Trajectories are bit-identical either way (pure layout change; the
+    # arithmetic is the same wide ops — test-pinned), so the flag is a
+    # perf knob, not a semantics knob.
+    fuse_packed_step: bool = False
+
     # Packed-state tick ceiling (ISSUE 9): the per-lane tick count the
     # PACKED ClusterState layout (state.PackedClusterState) is sized for.
     # Every tick-derived quantity is bounded by it — term bumps at most
@@ -245,7 +261,7 @@ class SimConfig:
         return SimConfig(
             n_nodes=self.n_nodes, log_cap=self.log_cap, ae_max=self.ae_max,
             max_lane_ticks=self.max_lane_ticks, compact_every=1, bug=self.bug,
-            metrics=self.metrics,
+            metrics=self.metrics, fuse_packed_step=self.fuse_packed_step,
         )
 
 

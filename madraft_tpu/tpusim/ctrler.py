@@ -79,13 +79,33 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
-from madraft_tpu.tpusim.engine import FuzzProgram
+from madraft_tpu.tpusim.config import (
+    LEADER,
+    NOOP_CMD,
+    SimConfig,
+    packed_bounds,
+)
+from madraft_tpu.tpusim.engine import (
+    FuzzProgram,
+    attach_layout_telemetry,
+    choose_layout_from_reason,
+)
+from madraft_tpu.tpusim.metrics import fold_latencies
 from madraft_tpu.tpusim.state import (
+    BOOL,
     ClusterState,
     I32,
+    PackedClusterState,
+    U8,
     durable_after_append,
     init_cluster,
+    pack_fields,
+    pack_state,
+    packed_layout_reason,
+    packed_spec_for,
+    uint_for,
+    unpack_fields,
+    unpack_state,
 )
 from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 
@@ -450,6 +470,13 @@ class CtrlerState(NamedTuple):
     clerk_acked: jax.Array  # i32 highest committed seq
     clerk_q_obs: jax.Array  # i32 node-served Query observation (-1 = none)
     queries_done: jax.Array  # i32 completed Queries (workload metric)
+    clerk_sub: jax.Array    # i32 [NC] submit stamp: tick the outstanding op
+    #                         STARTED (ISSUE 11 satellite; zero-size with
+    #                         cfg.metrics off — the kv.py clerk_sub
+    #                         treatment, closing PR 10's documented
+    #                         events-only gap). At ack, t - clerk_sub folds
+    #                         into the raft lat_hist: the client-experienced
+    #                         submit->ack latency, retries included
     # --- per-node apply machines (live + persisted snapshot) ---
     applied: jax.Array      # i32 [N] apply cursor, absolute
     last_seq: jax.Array     # i32 [N, NC] dup table
@@ -506,6 +533,7 @@ def init_ctrler_cluster(
         clerk_acked=jnp.zeros((nc,), I32),
         clerk_q_obs=jnp.full((nc,), -1, I32),
         queries_done=jnp.zeros((nc,), I32),
+        clerk_sub=jnp.zeros((nc if cfg.metrics else 0,), I32),
         applied=jnp.zeros((n,), I32),
         last_seq=jnp.zeros((n, nc), I32),
         member=jnp.zeros((n, ng), jnp.bool_),
@@ -539,11 +567,23 @@ def ctrler_step(
         kn = cfg.knobs()
     if ckn is None:
         ckn = kcfg.knobs()
-    n, cap, nc = cfg.n_nodes, cfg.log_cap, kcfg.n_clients
-    me = jnp.arange(n, dtype=I32)
-
     pre = ks.raft
     s = step_cluster(cfg, pre, cluster_key, kn)
+    return _ctrler_service_tick(
+        cfg, kcfg, ks, pre.alive, pre.base, s, cluster_key, kn, ckn
+    )
+
+
+def _ctrler_service_tick(
+    cfg: SimConfig, kcfg: CtrlerConfig, ks: CtrlerState,
+    pre_alive: jax.Array, pre_base: jax.Array, s: ClusterState,
+    cluster_key: jax.Array, kn, ckn,
+) -> CtrlerState:
+    """The service share of one tick given the STEPPED raft ``s`` and the
+    two pre-tick raft views it needs (alive/base) — ONE copy of the math
+    for the wide step and the fused packed step (the kv.py contract)."""
+    n, cap, nc = cfg.n_nodes, cfg.log_cap, kcfg.n_clients
+    me = jnp.arange(n, dtype=I32)
     t = s.tick
     key = jax.random.fold_in(cluster_key, t)
 
@@ -556,7 +596,7 @@ def ctrler_step(
 
     # 1. Crash/restart: live machine resets to the persisted snapshot; replay
     #    from base rebuilds the rest (restore-then-replay, raft.rs:194-211).
-    fresh_node = (~pre.alive & s.alive) | ~s.alive
+    fresh_node = (~pre_alive & s.alive) | ~s.alive
     fz = fresh_node[:, None]
     applied = jnp.where(fresh_node, s.base, applied)
     last_seq = jnp.where(fz, snap_last_seq, last_seq)
@@ -568,7 +608,7 @@ def ctrler_step(
     # 2. Compaction: capture the live tables as the persisted snapshot at the
     #    new base (the boundary is the pre-tick apply cursor; kv.py pattern).
     inst = s.snap_installed_src >= 0
-    comp = (s.base != pre.base) & ~inst & s.alive
+    comp = (s.base != pre_base) & ~inst & s.alive
     cz = comp[:, None]
     snap_last_seq = jnp.where(cz, last_seq, snap_last_seq)
     snap_member = jnp.where(cz, member, snap_member)
@@ -700,6 +740,13 @@ def ctrler_step(
     clerk_acked = jnp.where(newly_acked, ks.clerk_seq, ks.clerk_acked)
     clerk_out = ks.clerk_out & ~newly_acked
     queries_done = ks.queries_done + done_q.astype(I32)
+    # metrics (ISSUE 11 satellite): the ack is the clerk's Ok reply — fold
+    # the op's whole submit->ack latency into the cluster histogram (the
+    # kv.py clerk fold; ctrler ops carry log_tick 0, so the raft layer's
+    # own commit fold never double-counts them)
+    lat_hist = s.lat_hist
+    if cfg.metrics:
+        lat_hist = fold_latencies(lat_hist, t - ks.clerk_sub, newly_acked)
 
     # start fresh ops / retry pending ones
     kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 7)
@@ -759,6 +806,12 @@ def ctrler_step(
     clerk_kind = jnp.where(start, new_kind, ks.clerk_kind)
     clerk_arg = jnp.where(start, new_arg, ks.clerk_arg)
     clerk_q_obs = jnp.where(start, -1, clerk_q_obs)
+    clerk_sub = ks.clerk_sub
+    if cfg.metrics:
+        # submit stamp: the latency window opens at op start (an op never
+        # acks in its start tick — the shadow ack needs a commit, which
+        # takes at least one tick)
+        clerk_sub = jnp.where(start, t, clerk_sub)
     clerk_out = clerk_out | start
     retry = clerk_out & (
         start | jax.random.bernoulli(kk[2], ckn.p_retry, (nc,))
@@ -798,6 +851,7 @@ def ctrler_step(
         violations=violations,
         first_violation_tick=first_violation_tick,
         compact_floor=applied,
+        lat_hist=lat_hist,
     )
     return CtrlerState(
         raft=raft,
@@ -808,6 +862,7 @@ def ctrler_step(
         clerk_acked=clerk_acked,
         clerk_q_obs=clerk_q_obs,
         queries_done=queries_done,
+        clerk_sub=clerk_sub,
         applied=applied,
         last_seq=last_seq,
         member=member,
@@ -831,6 +886,153 @@ def ctrler_step(
     )
 
 
+# ---------------------------------------------------------------------------
+# Packed controller carry (ISSUE 11; the derivation contract is kv.py's:
+# every width below comes from config.packed_bounds plus the static
+# CtrlerConfig under the exact-or-wide rule, and the embedded raft group
+# re-derives its index/cmd dtypes for the service append rate).
+# ---------------------------------------------------------------------------
+
+_CTRL_RAFT_WRITES = (
+    "log_term", "log_val", "log_len", "durable_len", "violations",
+    "first_violation_tick", "compact_floor", "lat_hist",
+)
+
+
+@functools.lru_cache(maxsize=None)
+def ctrler_packed_layout(cfg: SimConfig, kcfg: CtrlerConfig) -> tuple:
+    """(raft PackedSpec, service field -> dtype table). Bounds: seq <=
+    min(T, _SEQ_LIM - 1) (one clerk start per tick), raft index <=
+    (n_clients + 1) * T + 1 (submits + leader no-op per node per tick),
+    cmd <= the top packed op; gids fit i8 (n_gids <= N_SHARDS = 10),
+    config nums fit their n_configs bound; the 31-bit config hashes
+    (hist / w_hist / q_obs) stay full-width i32 by design."""
+    b = packed_bounds(cfg)
+    nc, ncfg = kcfg.n_clients, kcfg.n_configs
+    idx_bound = (nc + 1) * b.tick + 1
+    cmd_bound = _pack(kcfg, nc - 1, _SEQ_LIM - 1, kcfg._arg_lim - 1, _QUERY)
+    sp = packed_spec_for(cfg, index_bound=idx_bound, cmd_bound=cmd_bound)
+    seq = uint_for(min(b.tick, _SEQ_LIM - 1))
+    num = uint_for(ncfg - 1)
+    dts = {
+        "clerk_seq": seq,
+        "clerk_out": BOOL,
+        "clerk_arg": uint_for(kcfg._arg_lim - 1),
+        "clerk_kind": U8,
+        "clerk_acked": seq,
+        "clerk_q_obs": I32,            # 31-bit config hash (-1 sentinel)
+        "queries_done": sp.tick,
+        "clerk_sub": sp.tick,
+        "applied": sp.index,
+        "last_seq": seq,
+        "member": BOOL,
+        "owner": jnp.int8,             # gid, -1 sentinel (n_gids <= 10)
+        "cfg_num": num,
+        "hist": I32,                   # full-width hash by design
+        "snap_last_seq": seq,
+        "snap_member": BOOL,
+        "snap_owner": jnp.int8,
+        "snap_cfg_num": num,
+        "snap_hist": I32,
+        "w_frontier": sp.index,
+        "w_last_seq": seq,
+        "w_member": BOOL,
+        "w_owner": jnp.int8,
+        "w_cfg_num": num,
+        "w_hist": I32,
+        "w_q_seq": seq,
+        "w_q_obs": I32,
+        "w_stalled": BOOL,
+    }
+    return sp, dts
+
+
+class PackedCtrlerState(NamedTuple):
+    """CtrlerState in the packed schema (field names mirror CtrlerState;
+    widths per ctrler_packed_layout)."""
+
+    raft: PackedClusterState
+    clerk_seq: jax.Array
+    clerk_out: jax.Array
+    clerk_arg: jax.Array
+    clerk_kind: jax.Array
+    clerk_acked: jax.Array
+    clerk_q_obs: jax.Array
+    queries_done: jax.Array
+    clerk_sub: jax.Array
+    applied: jax.Array
+    last_seq: jax.Array
+    member: jax.Array
+    owner: jax.Array
+    cfg_num: jax.Array
+    hist: jax.Array
+    snap_last_seq: jax.Array
+    snap_member: jax.Array
+    snap_owner: jax.Array
+    snap_cfg_num: jax.Array
+    snap_hist: jax.Array
+    w_frontier: jax.Array
+    w_last_seq: jax.Array
+    w_member: jax.Array
+    w_owner: jax.Array
+    w_cfg_num: jax.Array
+    w_hist: jax.Array
+    w_q_seq: jax.Array
+    w_q_obs: jax.Array
+    w_stalled: jax.Array
+
+
+def pack_ctrler_state(cfg: SimConfig, kcfg: CtrlerConfig,
+                      ks: CtrlerState) -> PackedCtrlerState:
+    sp, dts = ctrler_packed_layout(cfg, kcfg)
+    return PackedCtrlerState(raft=pack_state(cfg, ks.raft, sp),
+                             **pack_fields(ks, dts))
+
+
+def unpack_ctrler_state(cfg: SimConfig, kcfg: CtrlerConfig,
+                        p: PackedCtrlerState) -> CtrlerState:
+    sp, dts = ctrler_packed_layout(cfg, kcfg)
+    return CtrlerState(raft=unpack_state(cfg, p.raft, sp),
+                       **unpack_fields(p, dts))
+
+
+def ctrler_packed_layout_reason(cfg: SimConfig, kcfg: CtrlerConfig, kn, ckn,
+                                ticks_needed: int) -> Optional[str]:
+    """None when the packed controller schema is exact for this run — the
+    ctrler layer adds no dynamic-knob gates beyond the raft ones (every
+    service width derives from static config fields alone)."""
+    return packed_layout_reason(cfg, kn, ticks_needed)
+
+
+def ctrler_step_packed(
+    cfg: SimConfig, kcfg: CtrlerConfig, pks: PackedCtrlerState,
+    cluster_key: jax.Array, kn=None, ckn=None,
+) -> PackedCtrlerState:
+    """One tick over the PACKED controller carry; with cfg.fuse_packed_step
+    the composition is per field group (the kv_step_packed contract — raft
+    passthrough fields never widen, only _CTRL_RAFT_WRITES re-pack)."""
+    if kn is None:
+        _check_ctrler_cfg(cfg)
+        kn = cfg.knobs()
+    if ckn is None:
+        ckn = kcfg.knobs()
+    if not cfg.fuse_packed_step:
+        return pack_ctrler_state(cfg, kcfg, ctrler_step(
+            cfg, kcfg, unpack_ctrler_state(cfg, kcfg, pks), cluster_key,
+            kn, ckn,
+        ))
+    sp, dts = ctrler_packed_layout(cfg, kcfg)
+    pre = unpack_state(cfg, pks.raft, sp)
+    ps = pack_state(cfg, step_cluster(cfg, pre, cluster_key, kn), sp)
+    s = unpack_state(cfg, ps, sp)
+    ks = CtrlerState(raft=s, **unpack_fields(pks, dts))
+    nks = _ctrler_service_tick(cfg, kcfg, ks, pre.alive, pre.base, s,
+                               cluster_key, kn, ckn)
+    pw = pack_state(cfg, nks.raft, sp)
+    raft = ps._replace(**{f: getattr(pw, f) for f in _CTRL_RAFT_WRITES})
+    return PackedCtrlerState(raft=raft, **pack_fields(nks, dts))
+
+
 # ------------------------------------------------------------------- drivers
 class CtrlerFuzzReport(NamedTuple):
     violations: np.ndarray            # i32 bitmask per cluster
@@ -842,10 +1044,12 @@ class CtrlerFuzzReport(NamedTuple):
     msg_count: np.ndarray
     snap_installs: np.ndarray
     walker_stalled: np.ndarray        # bool: oracle coverage lost (see state)
-    # metrics plane (ISSUE 10): liveness counters only — the ctrler clerk
-    # carries no latency stamps yet (the kv/shardkv clerk_sub treatment is
-    # queued with ROADMAP item 4's scenario work), so there is no lat_hist
-    # field and a --metrics run reports events without a latency dict
+    # metrics plane (ISSUE 10 + the ISSUE 11 clerk-latency satellite): the
+    # ctrler clerk now stamps clerk_sub at op start and folds t - sub at
+    # ack, exactly like kv/shardkv — a --metrics run reports a real
+    # latency block alongside the events (the PR-10 events-only gap is
+    # closed); both None with cfg.metrics off
+    lat_hist: Optional[np.ndarray] = None
     ev_counts: Optional[np.ndarray] = None
 
     @property
@@ -860,14 +1064,18 @@ class CtrlerFuzzReport(NamedTuple):
 def _ctrler_program(
     static_cfg: SimConfig, static_kcfg: CtrlerConfig, n_clusters: int,
     mesh: Optional[Mesh], per_cluster_knobs: bool = False,
+    packed: bool = False,
 ):
     """One compiled program per static shape; probabilities, bug modes, and
     tick count are runtime args (uniform scalars — the fast knob layout;
-    the per-cluster layout serves make_ctrler_sweep_fn only)."""
+    the per-cluster layout serves make_ctrler_sweep_fn only). ``packed``
+    carries the fori loop in the PackedCtrlerState (a separate cached
+    program; the final state is widened before returning)."""
     constraint = None
     if mesh is not None:
         constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
     kn_ax = 0 if per_cluster_knobs else None
+    step_fn = ctrler_step_packed if packed else ctrler_step
 
     def run(seed, kn, ckn, n_ticks) -> CtrlerState:
         base = jax.random.PRNGKey(seed)
@@ -878,6 +1086,10 @@ def _ctrler_program(
             functools.partial(init_ctrler_cluster, static_cfg, static_kcfg),
             in_axes=(0, kn_ax),
         )(keys, kn)
+        if packed:
+            states = jax.vmap(
+                functools.partial(pack_ctrler_state, static_cfg, static_kcfg)
+            )(states)
         if constraint is not None:
             states = jax.lax.with_sharding_constraint(
                 states, jax.tree.map(lambda _: constraint, states)
@@ -891,13 +1103,29 @@ def _ctrler_program(
 
         def body(_, carry):
             return jax.vmap(
-                functools.partial(ctrler_step, static_cfg, static_kcfg),
+                functools.partial(step_fn, static_cfg, static_kcfg),
                 in_axes=(0, 0, kn_ax, kn_ax),
             )(carry, keys, kn, ckn)
 
-        return jax.lax.fori_loop(0, n_ticks, body, states)
+        final = jax.lax.fori_loop(0, n_ticks, body, states)
+        if packed:
+            final = jax.vmap(
+                functools.partial(unpack_ctrler_state, static_cfg,
+                                  static_kcfg)
+            )(final)
+        return final
 
     return jax.jit(run)
+
+
+def _ctrler_layout_telemetry(fn, cfg, kcfg, n_clusters, packed, layout,
+                             reason):
+    return attach_layout_telemetry(
+        fn, n_clusters, packed, layout, reason,
+        lambda: pack_ctrler_state(
+            cfg, kcfg, init_ctrler_cluster(cfg, kcfg, jax.random.PRNGKey(0))
+        ),
+    )
 
 
 def make_ctrler_fuzz_fn(
@@ -906,17 +1134,24 @@ def make_ctrler_fuzz_fn(
     n_clusters: int,
     n_ticks: int,
     mesh: Optional[Mesh] = None,
+    pack_states: Optional[bool] = None,
 ):
-    """Build fn(seed) -> final batched CtrlerState (see engine.make_fuzz_fn)."""
+    """Build fn(seed) -> final batched CtrlerState (see engine.make_fuzz_fn;
+    ``pack_states`` follows the make_kv_fuzz_fn exact-or-wide contract)."""
     _check_ctrler_cfg(cfg)
-    prog = _ctrler_program(cfg.static_key(), kcfg.static_key(), n_clusters, mesh)
     kn = cfg.knobs()
     ckn = kcfg.knobs()
+    reason = ctrler_packed_layout_reason(cfg, kcfg, kn, ckn, n_ticks)
+    packed, layout = choose_layout_from_reason(reason, pack_states)
+    prog = _ctrler_program(cfg.static_key(), kcfg.static_key(), n_clusters,
+                           mesh, False, packed)
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return FuzzProgram(
+    fn = FuzzProgram(
         prog,
         lambda seed: (jnp.asarray(seed, jnp.uint32), kn, ckn, ticks),
     )
+    return _ctrler_layout_telemetry(fn, cfg, kcfg, n_clusters, packed,
+                                    layout, reason)
 
 
 def _validate_ctrler_knobs(ckn) -> None:
@@ -945,6 +1180,7 @@ def make_ctrler_sweep_fn(
     n_clusters: int,
     n_ticks: int,
     mesh: Optional[Mesh] = None,
+    pack_states: Optional[bool] = None,
 ):
     """Like make_ctrler_fuzz_fn, but every cluster runs its own raft AND
     service knobs — fault intensity, op mix, and the planted rebalance bugs
@@ -958,15 +1194,19 @@ def make_ctrler_sweep_fn(
     _validate_knobs(knobs)
     validate_service_raft_knobs(knobs)
     _validate_ctrler_knobs(cknobs)
+    reason = ctrler_packed_layout_reason(cfg, kcfg, knobs, cknobs, n_ticks)
+    packed, layout = choose_layout_from_reason(reason, pack_states)
     prog = _ctrler_program(cfg.static_key(), kcfg.static_key(), n_clusters,
-                           mesh, per_cluster_knobs=True)
+                           mesh, True, packed)
     kn = knobs.broadcast(n_clusters)
     ckn = cknobs.broadcast(n_clusters)
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return FuzzProgram(
+    fn = FuzzProgram(
         prog,
         lambda seed: (jnp.asarray(seed, jnp.uint32), kn, ckn, ticks),
     )
+    return _ctrler_layout_telemetry(fn, cfg, kcfg, n_clusters, packed,
+                                    layout, reason)
 
 
 def ctrler_report(final: CtrlerState) -> CtrlerFuzzReport:
@@ -980,6 +1220,10 @@ def ctrler_report(final: CtrlerState) -> CtrlerFuzzReport:
         msg_count=np.asarray(final.raft.msg_count),
         snap_installs=np.asarray(final.raft.snap_install_count),
         walker_stalled=np.asarray(final.w_stalled),
+        lat_hist=(
+            np.asarray(final.raft.lat_hist)
+            if final.raft.lat_hist.size else None
+        ),
         ev_counts=(
             np.asarray(final.raft.ev_counts)
             if final.raft.ev_counts.size else None
@@ -1002,27 +1246,40 @@ def ctrler_fuzz(
 
 
 @functools.lru_cache(maxsize=None)
-def _ctrler_replay_program(static_cfg: SimConfig, static_kcfg: CtrlerConfig):
+def _ctrler_replay_program(static_cfg: SimConfig, static_kcfg: CtrlerConfig,
+                           packed: bool = False):
+    step_fn = ctrler_step_packed if packed else ctrler_step
+
     def run(cluster_id, kn, ckn, n_ticks, seed):
         ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
         state = init_ctrler_cluster(static_cfg, static_kcfg, ckey, kn)
+        if packed:
+            state = pack_ctrler_state(static_cfg, static_kcfg, state)
 
         def body(_, carry):
-            return ctrler_step(static_cfg, static_kcfg, carry, ckey, kn, ckn)
+            return step_fn(static_cfg, static_kcfg, carry, ckey, kn, ckn)
 
-        return jax.lax.fori_loop(0, n_ticks, body, state)
+        final = jax.lax.fori_loop(0, n_ticks, body, state)
+        if packed:
+            final = unpack_ctrler_state(static_cfg, static_kcfg, final)
+        return final
 
     return jax.jit(run)
 
 
 def ctrler_replay_cluster(
     cfg: SimConfig, kcfg: CtrlerConfig, seed: int, cluster_id: int,
-    n_ticks: int,
+    n_ticks: int, pack_states: Optional[bool] = None,
 ) -> CtrlerState:
-    """Re-run one cluster exactly (the (seed, cluster_id) replay contract)."""
+    """Re-run one cluster exactly (the (seed, cluster_id) replay contract;
+    layout-blind — the packed carry replays bit-identically, test-pinned)."""
     _check_ctrler_cfg(cfg)
-    prog = _ctrler_replay_program(cfg.static_key(), kcfg.static_key())
+    kn, ckn = cfg.knobs(), kcfg.knobs()
+    packed, _ = choose_layout_from_reason(
+        ctrler_packed_layout_reason(cfg, kcfg, kn, ckn, n_ticks), pack_states
+    )
+    prog = _ctrler_replay_program(cfg.static_key(), kcfg.static_key(), packed)
     return jax.block_until_ready(
-        prog(jnp.asarray(cluster_id, jnp.int32), cfg.knobs(), kcfg.knobs(),
+        prog(jnp.asarray(cluster_id, jnp.int32), kn, ckn,
              jnp.asarray(n_ticks, jnp.int32), jnp.asarray(seed, jnp.uint32))
     )

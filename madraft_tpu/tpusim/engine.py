@@ -28,6 +28,7 @@ from madraft_tpu.tpusim.config import (
 )
 from madraft_tpu.tpusim.state import (
     ClusterState,
+    abstract_bytes,
     init_cluster,
     pack_state,
     packed_layout_reason,
@@ -228,6 +229,12 @@ def run_telemetry(fn, rep_fn, seed, n_steps: int,
         tele["state_layout"] = getattr(fn, "state_layout", "wide")
         tele["state_hbm_bytes"] = sb
         tele["bytes_per_lane"] = round(sb / n_lanes, 1)
+        # exact-or-wide fallback: when a runner chose the wide layout
+        # because a bound failed, say WHICH bound (ISSUE 11) — a silent
+        # "wide" reads as a regression, not a gate
+        reason = getattr(fn, "state_layout_reason", None)
+        if reason:
+            tele["state_layout_reason"] = reason
     if compile_s is not None:
         tele["compile_s"] = round(compile_s, 4)
     else:
@@ -872,16 +879,15 @@ def _summary_fields(compile_s: float, gap: float, wait: float,
     return tele, id_fields
 
 
-def _choose_layout(cfg: SimConfig, kn, ticks_needed: int,
-                   pack_states: Optional[bool]) -> tuple:
-    """The ONE layout-choice rule for every packed-capable program
-    (chunked fuzz, pool, coverage pool; trace/replay apply the same rule
-    through state.packed_layout_reason directly): auto-pack when the packed
-    schema is exact for the run, fall back to wide otherwise — and refuse a
-    FORCED pack that would be inexact, because a silently-wrapping narrow
-    dtype corrupts trajectories instead of failing a bound. Returns
-    (packed, layout_string)."""
-    reason = packed_layout_reason(cfg, kn, ticks_needed)
+def choose_layout_from_reason(reason: Optional[str],
+                              pack_states: Optional[bool]) -> tuple:
+    """The layout DECISION rule on a precomputed exactness reason: auto-pack
+    when the packed schema is exact (reason None), fall back to wide
+    otherwise — and refuse a FORCED pack that would be inexact, because a
+    silently-wrapping narrow dtype corrupts trajectories instead of failing
+    a bound. The raft paths feed it state.packed_layout_reason via
+    _choose_layout; the service layers (ISSUE 11) feed it their own
+    kv/ctrler/shardkv layout reasons. Returns (packed, layout_string)."""
     if pack_states is None:
         packed = reason is None
     elif pack_states and reason is not None:
@@ -890,6 +896,37 @@ def _choose_layout(cfg: SimConfig, kn, ticks_needed: int,
     else:
         packed = bool(pack_states)
     return packed, ("packed" if packed else "wide")
+
+
+def _choose_layout(cfg: SimConfig, kn, ticks_needed: int,
+                   pack_states: Optional[bool]) -> tuple:
+    """The ONE layout-choice rule for every packed-capable raft program
+    (chunked fuzz, pool, coverage pool; trace/replay apply the same rule
+    through state.packed_layout_reason directly)."""
+    return choose_layout_from_reason(
+        packed_layout_reason(cfg, kn, ticks_needed), pack_states
+    )
+
+
+def attach_layout_telemetry(fn, n_lanes: int, packed: bool, layout: str,
+                            reason: Optional[str], packed_shapes):
+    """Attach the resident-carry telemetry attrs run_telemetry reads
+    (state_layout / state_hbm_bytes / bytes_per_lane — the
+    make_chunked_fuzz_fn attr contract — plus the wide-fallback reason).
+    ONE copy for the three service runners (ISSUE 11). ``packed_shapes``
+    is a thunk building one lane's packed carry, evaluated via
+    jax.eval_shape — the true buffer sizes the program holds, with no
+    device allocation; a wide run's final state IS its resident carry, so
+    telemetry falls back to measuring that directly."""
+    fn.state_layout = layout
+    if reason is not None:
+        fn.state_layout_reason = reason
+    if packed:
+        fn.state_hbm_bytes = n_lanes * abstract_bytes(
+            jax.eval_shape(packed_shapes)
+        )
+        fn.bytes_per_lane = round(fn.state_hbm_bytes / n_lanes, 1)
+    return fn
 
 
 def make_chunked_fuzz_fn(

@@ -45,14 +45,34 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from madraft_tpu.tpusim.config import LEADER, NOOP_CMD, SimConfig
-from madraft_tpu.tpusim.engine import FuzzProgram
+from madraft_tpu.tpusim.config import (
+    LEADER,
+    NOOP_CMD,
+    SimConfig,
+    packed_bounds,
+)
+from madraft_tpu.tpusim.engine import (
+    FuzzProgram,
+    attach_layout_telemetry,
+    choose_layout_from_reason,
+)
 from madraft_tpu.tpusim.metrics import fold_latencies
 from madraft_tpu.tpusim.state import (
+    BOOL,
     ClusterState,
     I32,
+    PackedClusterState,
+    U8,
     durable_after_append,
     init_cluster,
+    pack_fields,
+    pack_state,
+    packed_layout_reason,
+    packed_spec_for,
+    sint_for,
+    uint_for,
+    unpack_fields,
+    unpack_state,
 )
 from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 
@@ -307,11 +327,27 @@ def kv_step(
         kn = cfg.knobs()
     if kkn is None:
         kkn = kcfg.knobs()
-    n, cap, nc = cfg.n_nodes, cfg.log_cap, kcfg.n_clients
-    me = jnp.arange(n, dtype=I32)
-
     pre = ks.raft
     s = step_cluster(cfg, pre, cluster_key, kn)
+    return _kv_service_tick(
+        cfg, kcfg, ks, pre.alive, pre.base, pre.shadow_len, s, cluster_key,
+        kn, kkn,
+    )
+
+
+def _kv_service_tick(
+    cfg: SimConfig, kcfg: KvConfig, ks: KvState,
+    pre_alive: jax.Array, pre_base: jax.Array, pre_shadow_len: jax.Array,
+    s: ClusterState, cluster_key: jax.Array, kn, kkn,
+) -> KvState:
+    """The service share of one tick — apply machines, oracles, clerks —
+    given the STEPPED raft state ``s`` and the three pre-tick raft views it
+    needs (alive/base/shadow_len). ONE copy of the math for the wide step
+    and the fused packed step (kv_step_packed): the fused path feeds it a
+    widened VIEW of the packed carry, so packed-vs-wide bit-identity is a
+    property of pack/unpack exactness, never of a parallel implementation."""
+    n, cap, nc = cfg.n_nodes, cfg.log_cap, kcfg.n_clients
+    me = jnp.arange(n, dtype=I32)
     t = s.tick
     key = jax.random.fold_in(cluster_key, t)
     nk = kcfg.n_keys
@@ -329,7 +365,7 @@ def kv_step(
     sh_client, sh_seq, sh_key, sh_kind = _unpack(kcfg, s.shadow_val)
     sh_client = jnp.clip(sh_client, 0, nc - 1)
     sh_new = (
-        (sh_abs_now > pre.shadow_len) & (sh_abs_now <= s.shadow_len)
+        (sh_abs_now > pre_shadow_len) & (sh_abs_now <= s.shadow_len)
         & (s.shadow_val != NOOP_CMD)  # leader no-ops are not client ops
     )
     cl_oh_sh = sh_client[:, None] == jnp.arange(nc, dtype=I32)[None, :]  # [cap, nc]
@@ -362,7 +398,7 @@ def kv_step(
     # 1. Crash/restart: the live apply machine resets to the node's own
     #    persisted snapshot; log replay from base rebuilds the rest
     #    (restore() + apply-channel replay, raft.rs:194-211).
-    fresh = (~pre.alive & s.alive) | ~s.alive
+    fresh = (~pre_alive & s.alive) | ~s.alive
     applied = jnp.where(fresh, s.base, applied)
     last_seq = jnp.where(fresh[:, None], snap_last_seq, last_seq)
     apply_count = jnp.where(fresh[:, None], snap_apply_count, apply_count)
@@ -374,7 +410,7 @@ def kv_step(
     #    tick's apply loop are exactly the state at the new base — capture
     #    them as the persisted snapshot (rsm.h maybe_snapshot).
     inst = s.snap_installed_src >= 0
-    comp = (s.base != pre.base) & ~inst & s.alive
+    comp = (s.base != pre_base) & ~inst & s.alive
     snap_last_seq = jnp.where(comp[:, None], last_seq, snap_last_seq)
     snap_apply_count = jnp.where(comp[:, None], apply_count, snap_apply_count)
     snap_key_hash = jnp.where(comp[:, None], key_hash, snap_key_hash)
@@ -731,6 +767,179 @@ def kv_step(
     )
 
 
+# ---------------------------------------------------------------------------
+# Packed KV carry (ISSUE 11; the raft-layer schema notes live in state.py).
+#
+# The service fields follow the same EXACT-OR-WIDE rule as the raft layer:
+# every width below derives from config.packed_bounds plus the static
+# KvConfig, so a value can only exceed its dtype by violating a derived
+# bound — and the layout gate (kv_packed_layout_reason) refuses to pack any
+# run whose bounds do not hold. The embedded raft group re-derives its
+# index/cmd dtypes for the service append rate: a kv tick appends up to
+# n_clients client entries plus the leader no-op per node per tick (the
+# raft layer's 2-per-tick rule does not hold here), and the log carries
+# packed (client, seq, key, kind) ops far above the raft cmd bound.
+# ---------------------------------------------------------------------------
+
+# Raft fields the service tick writes (everything else flows through the
+# packed raft group untouched on the fused path).
+_KV_RAFT_WRITES = (
+    "log_term", "log_val", "log_len", "durable_len", "violations",
+    "first_violation_tick", "compact_floor", "lat_hist",
+)
+
+
+@functools.lru_cache(maxsize=None)
+def kv_packed_layout(cfg: SimConfig, kcfg: KvConfig) -> tuple:
+    """(raft PackedSpec, service field -> dtype table): the whole width
+    derivation for one static (SimConfig, KvConfig) pair — the one place
+    the schema, the pack/unpack pair, and the width-pinning tests read.
+
+    Bounds (T = cfg.max_lane_ticks, b = packed_bounds(cfg)):
+      seq     <= min(T, _SEQ_LIM - 1)   (a clerk starts at most one op/tick)
+      index   <= (n_clients + 1) * T + 1  (submits + leader no-op per node
+                                           per tick; applied/apply_count/
+                                           key_count are all <= log_len,
+                                           which covers bug_skip_dedup's
+                                           duplicate applies too)
+      cmd     <= _pack(top op)          (the log's value channel carries
+                                         packed service ops)
+      obs     in {-1} U [0, index]      (Get observations; signed)
+    """
+    b = packed_bounds(cfg)
+    nc, nk = kcfg.n_clients, kcfg.n_keys
+    idx_bound = (nc + 1) * b.tick + 1
+    cmd_bound = _pack(kcfg, nc - 1, _SEQ_LIM - 1, nk - 1, 3)
+    sp = packed_spec_for(cfg, index_bound=idx_bound, cmd_bound=cmd_bound)
+    seq = uint_for(min(b.tick, _SEQ_LIM - 1))
+    obs = sint_for(idx_bound)
+    dts = {
+        "clerk_seq": seq,
+        "clerk_out": BOOL,
+        "clerk_key": uint_for(nk - 1),
+        "clerk_kind": U8,
+        "clerk_acked": seq,
+        "clerk_leader": jnp.int8,      # node id, -1 sentinel (n_nodes <= 16)
+        "clerk_wait": sp.tick,         # retry_wait gated <= b.tick
+        "clerk_sub": sp.tick,
+        "truth_count": sp.index,
+        "truth_max_seq": seq,
+        "clerk_get_lo": sp.index,
+        "clerk_get_obs": obs,
+        "clerk_last_obs": obs,
+        "gets_done": sp.tick,          # at most one completion per tick
+        "applied": sp.index,
+        "last_seq": seq,
+        "apply_count": sp.index,
+        "key_hash": I32,               # full-width hash by design
+        "key_count": sp.index,
+        "snap_last_seq": seq,
+        "snap_apply_count": sp.index,
+        "snap_key_hash": I32,
+        "snap_key_count": sp.index,
+    }
+    return sp, dts
+
+
+class PackedKvState(NamedTuple):
+    """KvState in the packed schema: the raft group as a PackedClusterState
+    (service-rate index/cmd dtypes) and every service field narrowed per
+    kv_packed_layout. Field names mirror KvState exactly, which is what
+    lets pack/unpack and the fused write-back stay table-driven."""
+
+    raft: PackedClusterState
+    clerk_seq: jax.Array
+    clerk_out: jax.Array
+    clerk_key: jax.Array
+    clerk_kind: jax.Array
+    clerk_acked: jax.Array
+    clerk_leader: jax.Array
+    clerk_wait: jax.Array
+    clerk_sub: jax.Array
+    truth_count: jax.Array
+    truth_max_seq: jax.Array
+    clerk_get_lo: jax.Array
+    clerk_get_obs: jax.Array
+    clerk_last_obs: jax.Array
+    gets_done: jax.Array
+    applied: jax.Array
+    last_seq: jax.Array
+    apply_count: jax.Array
+    key_hash: jax.Array
+    key_count: jax.Array
+    snap_last_seq: jax.Array
+    snap_apply_count: jax.Array
+    snap_key_hash: jax.Array
+    snap_key_count: jax.Array
+
+
+def pack_kv_state(cfg: SimConfig, kcfg: KvConfig, ks: KvState) -> PackedKvState:
+    sp, dts = kv_packed_layout(cfg, kcfg)
+    return PackedKvState(raft=pack_state(cfg, ks.raft, sp),
+                         **pack_fields(ks, dts))
+
+
+def unpack_kv_state(cfg: SimConfig, kcfg: KvConfig,
+                    p: PackedKvState) -> KvState:
+    sp, dts = kv_packed_layout(cfg, kcfg)
+    return KvState(raft=unpack_state(cfg, p.raft, sp),
+                   **unpack_fields(p, dts))
+
+
+def kv_packed_layout_reason(cfg: SimConfig, kcfg: KvConfig, kn, kkn,
+                            ticks_needed: int) -> Optional[str]:
+    """None when the packed KV schema is exact for this run — else the
+    human-readable wide-fallback reason (the state.packed_layout_reason
+    contract extended with the kv-layer gates)."""
+    r = packed_layout_reason(cfg, kn, ticks_needed)
+    if r is not None:
+        return r
+    k = jax.tree.map(np.asarray, kkn)
+    b = packed_bounds(cfg)
+    if (k.retry_wait > b.tick).any():
+        return (
+            f"retry_wait {k.retry_wait} > {b.tick}: the clerk await "
+            "countdown packs in the tick dtype"
+        )
+    return None
+
+
+def kv_step_packed(
+    cfg: SimConfig, kcfg: KvConfig, pks: PackedKvState,
+    cluster_key: jax.Array, kn=None, kkn=None,
+) -> PackedKvState:
+    """One tick over the PACKED KV carry. Default: widen-on-use at the
+    whole-state boundary (pack o kv_step o unpack — the ISSUE-9 idiom).
+    With cfg.fuse_packed_step the composition is PER FIELD GROUP instead:
+    the raft sub-tick consumes and produces the packed raft group, the
+    service tick reads a widened VIEW of only the raft fields it touches
+    (XLA DCE drops the rest), and only the fields the service WRITES
+    (_KV_RAFT_WRITES) are re-packed — the full wide raft pytree never
+    materializes between the raft layer and the service apply machines.
+    Both paths are bit-identical to the wide step (pack/unpack exactness;
+    test-pinned), so the flag is purely a fusion-layout choice."""
+    if kn is None:
+        _check_kv_cfg(cfg)
+        kn = cfg.knobs()
+    if kkn is None:
+        kkn = kcfg.knobs()
+    if not cfg.fuse_packed_step:
+        return pack_kv_state(cfg, kcfg, kv_step(
+            cfg, kcfg, unpack_kv_state(cfg, kcfg, pks), cluster_key, kn, kkn
+        ))
+    sp, dts = kv_packed_layout(cfg, kcfg)
+    pre = unpack_state(cfg, pks.raft, sp)  # alive/base/shadow_len + the
+    #                                        step's own reads survive DCE
+    ps = pack_state(cfg, step_cluster(cfg, pre, cluster_key, kn), sp)
+    s = unpack_state(cfg, ps, sp)          # the service's widened view
+    ks = KvState(raft=s, **unpack_fields(pks, dts))
+    nks = _kv_service_tick(cfg, kcfg, ks, pre.alive, pre.base,
+                           pre.shadow_len, s, cluster_key, kn, kkn)
+    pw = pack_state(cfg, nks.raft, sp)     # only the written fields survive
+    raft = ps._replace(**{f: getattr(pw, f) for f in _KV_RAFT_WRITES})
+    return PackedKvState(raft=raft, **pack_fields(nks, dts))
+
+
 # ------------------------------------------------------------------- drivers
 class KvFuzzReport(NamedTuple):
     violations: np.ndarray            # i32 bitmask per cluster
@@ -757,16 +966,21 @@ class KvFuzzReport(NamedTuple):
 def _kv_program(
     static_cfg: SimConfig, static_kcfg: KvConfig, n_clusters: int,
     mesh: Optional[Mesh], per_cluster_knobs: bool = False,
+    packed: bool = False,
 ):
     """One compiled program per static shape; probabilities, bug modes, and
     the tick count are runtime arguments. Knobs are UNIFORM runtime scalars
     (vmap in_axes=None) — the fast knob layout; per-cluster knob arrays
     measured a 2.4x cliff (see engine._fuzz_program) and are used only by
-    ``make_kv_sweep_fn``, which alone pays for its heterogeneity."""
+    ``make_kv_sweep_fn``, which alone pays for its heterogeneity. With
+    ``packed`` the fori carry is the PackedKvState (ISSUE 11) — a SEPARATE
+    cached program, so the wide HLO is untouched — and the final state is
+    widened before returning, so every report/consumer is layout-blind."""
     constraint = None
     if mesh is not None:
         constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
     kn_ax = 0 if per_cluster_knobs else None
+    step_fn = kv_step_packed if packed else kv_step
 
     def run(seed, kn, kkn, n_ticks) -> KvState:
         base = jax.random.PRNGKey(seed)
@@ -777,6 +991,10 @@ def _kv_program(
             functools.partial(init_kv_cluster, static_cfg, static_kcfg),
             in_axes=(0, kn_ax),
         )(keys, kn)
+        if packed:
+            states = jax.vmap(
+                functools.partial(pack_kv_state, static_cfg, static_kcfg)
+            )(states)
         if constraint is not None:
             states = jax.lax.with_sharding_constraint(
                 states, jax.tree.map(lambda _: constraint, states)
@@ -790,13 +1008,27 @@ def _kv_program(
 
         def body(_, carry):
             return jax.vmap(
-                functools.partial(kv_step, static_cfg, static_kcfg),
+                functools.partial(step_fn, static_cfg, static_kcfg),
                 in_axes=(0, 0, kn_ax, kn_ax),
             )(carry, keys, kn, kkn)
 
-        return jax.lax.fori_loop(0, n_ticks, body, states)
+        final = jax.lax.fori_loop(0, n_ticks, body, states)
+        if packed:
+            final = jax.vmap(
+                functools.partial(unpack_kv_state, static_cfg, static_kcfg)
+            )(final)
+        return final
 
     return jax.jit(run)
+
+
+def _kv_layout_telemetry(fn, cfg, kcfg, n_clusters, packed, layout, reason):
+    return attach_layout_telemetry(
+        fn, n_clusters, packed, layout, reason,
+        lambda: pack_kv_state(
+            cfg, kcfg, init_kv_cluster(cfg, kcfg, jax.random.PRNGKey(0))
+        ),
+    )
 
 
 def make_kv_fuzz_fn(
@@ -805,18 +1037,31 @@ def make_kv_fuzz_fn(
     n_clusters: int,
     n_ticks: int,
     mesh: Optional[Mesh] = None,
+    pack_states: Optional[bool] = None,
 ):
-    """Build fn(seed) -> final batched KvState (see engine.make_fuzz_fn)."""
+    """Build fn(seed) -> final batched KvState (see engine.make_fuzz_fn).
+
+    ``pack_states``: None (default) carries the loop state in the packed
+    KV schema whenever it is exact for this run (kv_packed_layout_reason);
+    True forces it (ValueError when inexact); False forces the wide carry.
+    The returned fn carries ``state_layout`` (+ ``state_layout_reason`` on
+    a wide fallback) and, when packed, ``state_hbm_bytes``/``bytes_per_lane``
+    — surfaced through the CLI fuzz telemetry."""
     _check_kv_cfg(cfg)
-    prog = _kv_program(cfg.static_key(), kcfg.static_key(), n_clusters, mesh)
     kn = cfg.knobs()    # uniform runtime scalars — the fast knob layout
     kkn = kcfg.knobs()
+    reason = kv_packed_layout_reason(cfg, kcfg, kn, kkn, n_ticks)
+    packed, layout = choose_layout_from_reason(reason, pack_states)
+    prog = _kv_program(cfg.static_key(), kcfg.static_key(), n_clusters, mesh,
+                       False, packed)
     ticks = jnp.asarray(n_ticks, jnp.int32)
     # uint32 coercion: keep the (seed, cluster_id) replay contract under x64
-    return FuzzProgram(
+    fn = FuzzProgram(
         prog,
         lambda seed: (jnp.asarray(seed, jnp.uint32), kn, kkn, ticks),
     )
+    return _kv_layout_telemetry(fn, cfg, kcfg, n_clusters, packed, layout,
+                                reason)
 
 
 def _validate_kv_knobs(kkn) -> None:
@@ -847,11 +1092,14 @@ def make_kv_sweep_fn(
     n_clusters: int,
     n_ticks: int,
     mesh: Optional[Mesh] = None,
+    pack_states: Optional[bool] = None,
 ):
     """Like make_kv_fuzz_fn, but every cluster runs its own raft AND
     service knobs — fault intensity, workload mix, and even the BUG
     injections become per-cluster data, so a whole mutation-testing matrix
-    (which clusters run which planted bug) executes in ONE program."""
+    (which clusters run which planted bug) executes in ONE program. The
+    layout gate sees the whole knob matrix (every per-cluster value must
+    respect the packed bounds, or the sweep falls back to wide)."""
     from madraft_tpu.tpusim.engine import (
         _validate_knobs,
         validate_service_raft_knobs,
@@ -861,15 +1109,19 @@ def make_kv_sweep_fn(
     _validate_knobs(knobs)
     validate_service_raft_knobs(knobs)
     _validate_kv_knobs(kknobs)
+    reason = kv_packed_layout_reason(cfg, kcfg, knobs, kknobs, n_ticks)
+    packed, layout = choose_layout_from_reason(reason, pack_states)
     prog = _kv_program(cfg.static_key(), kcfg.static_key(), n_clusters, mesh,
-                       per_cluster_knobs=True)
+                       True, packed)
     kn = knobs.broadcast(n_clusters)
     kkn = kknobs.broadcast(n_clusters)
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return FuzzProgram(
+    fn = FuzzProgram(
         prog,
         lambda seed: (jnp.asarray(seed, jnp.uint32), kn, kkn, ticks),
     )
+    return _kv_layout_telemetry(fn, cfg, kcfg, n_clusters, packed, layout,
+                                reason)
 
 
 def kv_report(final: KvState) -> KvFuzzReport:
@@ -902,26 +1154,41 @@ def kv_fuzz(
 
 
 @functools.lru_cache(maxsize=None)
-def _kv_replay_program(static_cfg: SimConfig, static_kcfg: KvConfig):
+def _kv_replay_program(static_cfg: SimConfig, static_kcfg: KvConfig,
+                       packed: bool = False):
+    step_fn = kv_step_packed if packed else kv_step
+
     def run(cluster_id, kn, kkn, n_ticks, seed):
         ckey = jax.random.fold_in(jax.random.PRNGKey(seed), cluster_id)
         state = init_kv_cluster(static_cfg, static_kcfg, ckey, kn)
+        if packed:
+            state = pack_kv_state(static_cfg, static_kcfg, state)
 
         def body(_, carry):
-            return kv_step(static_cfg, static_kcfg, carry, ckey, kn, kkn)
+            return step_fn(static_cfg, static_kcfg, carry, ckey, kn, kkn)
 
-        return jax.lax.fori_loop(0, n_ticks, body, state)
+        final = jax.lax.fori_loop(0, n_ticks, body, state)
+        if packed:
+            final = unpack_kv_state(static_cfg, static_kcfg, final)
+        return final
 
     return jax.jit(run)
 
 
 def kv_replay_cluster(
-    cfg: SimConfig, kcfg: KvConfig, seed: int, cluster_id: int, n_ticks: int
+    cfg: SimConfig, kcfg: KvConfig, seed: int, cluster_id: int, n_ticks: int,
+    pack_states: Optional[bool] = None,
 ) -> KvState:
-    """Re-run one cluster for inspection (the (seed, cluster_id) replay contract)."""
+    """Re-run one cluster for inspection (the (seed, cluster_id) replay
+    contract). Layout-blind: the packed carry replays bit-identically to
+    the wide one (test-pinned), and the returned state is always wide."""
     _check_kv_cfg(cfg)
-    prog = _kv_replay_program(cfg.static_key(), kcfg.static_key())
+    kn, kkn = cfg.knobs(), kcfg.knobs()
+    packed, _ = choose_layout_from_reason(
+        kv_packed_layout_reason(cfg, kcfg, kn, kkn, n_ticks), pack_states
+    )
+    prog = _kv_replay_program(cfg.static_key(), kcfg.static_key(), packed)
     return jax.block_until_ready(
-        prog(jnp.asarray(cluster_id, jnp.int32), cfg.knobs(), kcfg.knobs(),
+        prog(jnp.asarray(cluster_id, jnp.int32), kn, kkn,
              jnp.asarray(n_ticks, jnp.int32), jnp.asarray(seed, jnp.uint32))
     )

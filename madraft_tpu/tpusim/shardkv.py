@@ -79,15 +79,31 @@ from madraft_tpu.tpusim.config import (
     NOOP_CMD,
     SimConfig,
     metrics_dims,
+    packed_bounds,
 )
 from madraft_tpu.tpusim.ctrler import _rebalance as _ctrl_rebalance
-from madraft_tpu.tpusim.engine import FuzzProgram
+from madraft_tpu.tpusim.engine import (
+    FuzzProgram,
+    attach_layout_telemetry,
+    choose_layout_from_reason,
+)
 from madraft_tpu.tpusim.metrics import fold_latencies
 from madraft_tpu.tpusim.state import (
+    BOOL,
     ClusterState,
     I32,
+    PackedClusterState,
+    U8,
     durable_after_append,
     init_cluster,
+    pack_fields,
+    pack_state,
+    packed_layout_reason,
+    packed_spec_for,
+    sint_for,
+    uint_for,
+    unpack_fields,
+    unpack_state,
 )
 from madraft_tpu.tpusim.step import _lane_abs, _slot, step_cluster
 
@@ -634,11 +650,9 @@ def init_shardkv_cluster(
         # carry the smallest legal ClusterState instead of a full dead
         # cluster; shardkv throughput sits at the HBM working-set knee
         # (bench.py), so an unused n-node cluster per deployment is real
-        # bandwidth
+        # bandwidth (_ctrl_sim_cfg is the one copy of that choice)
         ctrl = init_cluster(
-            cfg.replace(n_nodes=1, log_cap=4, uncommitted_cap=1,
-                        compact_every=1),
-            jax.random.fold_in(key, _S_CTRL),
+            _ctrl_sim_cfg(cfg, kcfg), jax.random.fold_in(key, _S_CTRL)
         )
     ncfg = kcfg.n_configs
     owner0 = cfg_owner[0]
@@ -739,6 +753,17 @@ def init_shardkv_cluster(
     )
 
 
+def _ctrl_sim_cfg(cfg: SimConfig, kcfg: ShardKvConfig) -> SimConfig:
+    """The SimConfig of the deployment's controller cluster: the real one
+    when a controller mode is on, else the smallest legal placeholder (see
+    the init_shardkv_cluster note on the HBM working-set knee). The ONE
+    copy of that choice — init and the packed schema both read it."""
+    if kcfg.live_ctrler or kcfg.computed_ctrler:
+        return cfg
+    return cfg.replace(n_nodes=1, log_cap=4, uncommitted_cap=1,
+                       compact_every=1)
+
+
 def shardkv_step(
     cfg: SimConfig, kcfg: ShardKvConfig, st: ShardKvState,
     cluster_key: jax.Array, kn=None, skn=None,
@@ -752,15 +777,35 @@ def shardkv_step(
         kn = cfg.knobs()
     if skn is None:
         skn = kcfg.knobs()
-    g, n, cap = kcfg.n_groups, cfg.n_nodes, cfg.log_cap
-    ns, nc = kcfg.n_shards, kcfg.n_clients
     pre = st.rafts
     gkeys = jax.vmap(lambda i: jax.random.fold_in(cluster_key, _S_GROUP + i))(
-        jnp.arange(g)
+        jnp.arange(kcfg.n_groups)
     )
     s = jax.vmap(
         functools.partial(step_cluster, cfg), in_axes=(0, 0, None)
     )(pre, gkeys, kn)
+    if kcfg.live_ctrler or kcfg.computed_ctrler:
+        ctrl = step_cluster(
+            cfg, st.ctrl, jax.random.fold_in(cluster_key, _S_CTRL), kn
+        )
+    else:
+        ctrl = st.ctrl
+    return _shardkv_service_tick(
+        cfg, kcfg, st, pre.alive, pre.base, s, ctrl, cluster_key, kn, skn
+    )
+
+
+def _shardkv_service_tick(
+    cfg: SimConfig, kcfg: ShardKvConfig, st: ShardKvState,
+    pre_alive: jax.Array, pre_base: jax.Array, s: ClusterState,
+    ctrl: ClusterState, cluster_key: jax.Array, kn, skn,
+) -> ShardKvState:
+    """The service share of one deployment tick given the STEPPED group
+    rafts ``s``, the stepped (or passthrough) controller cluster ``ctrl``,
+    and the pre-tick raft views (alive/base) — ONE copy of the math for
+    the wide step and the fused packed step (the kv.py contract)."""
+    g, n, cap = kcfg.n_groups, cfg.n_nodes, cfg.log_cap
+    ns, nc = kcfg.n_shards, kcfg.n_clients
     t = s.tick[0]  # all groups tick in lockstep
     key = jax.random.fold_in(cluster_key, t)
     viol = jnp.asarray(0, I32)
@@ -776,7 +821,8 @@ def shardkv_step(
     # winner from the committed shadow log; groups may only ever adopt that
     # winner (VIOLATION_SHARD_CTRL_STALE otherwise). The reference's servers
     # poll this service via a ctrl-plane clerk (shardkv/server.rs:12-18).
-    ctrl = st.ctrl
+    # (``ctrl`` arrives already stepped when a controller mode is on —
+    # shardkv_step's raft sub-phase — and is the untouched carry otherwise.)
     win_var = st.win_var
     ctrl_w_frontier = st.ctrl_w_frontier
     ctrl_w_stalled = st.ctrl_w_stalled
@@ -785,9 +831,6 @@ def shardkv_step(
     cmem, slot_tick = st.cmem, st.slot_tick
     ctrl_node_owner, ctrl_maps = st.ctrl_node_owner, st.ctrl_maps
     if kcfg.live_ctrler or kcfg.computed_ctrler:
-        ctrl = step_cluster(
-            cfg, st.ctrl, jax.random.fold_in(cluster_key, _S_CTRL), kn
-        )
         lane1 = jnp.arange(cap, dtype=I32)
         csh_abs = _lane_abs(ctrl.shadow_base, cap)  # [cap]
     if kcfg.live_ctrler:
@@ -956,7 +999,7 @@ def shardkv_step(
 
     # 1. Crash/restart: live service state resets to the node's own persisted
     #    snapshot; replay from base rebuilds (kv.py pattern).
-    fresh = (~pre.alive & s.alive) | ~s.alive  # [G, N]
+    fresh = (~pre_alive & s.alive) | ~s.alive  # [G, N]
     applied = jnp.where(fresh, s.base, applied)
     node_cfg = jnp.where(fresh, snap_cfg, node_cfg)
     node_src = jnp.where(fresh, snap_src, node_src)
@@ -967,7 +1010,7 @@ def shardkv_step(
     # miss_change_4b coverage signal: how many config activations did a
     # restarting node sleep through? (It recovers by replaying CONFIG entries
     # / installing a snapshot — the max lag metric proves the scenario ran.)
-    restarted = (~pre.alive) & s.alive
+    restarted = (~pre_alive) & s.alive
     max_cfg_lag = jnp.maximum(
         st.max_cfg_lag,
         jnp.max(jnp.where(restarted, active_cfg - node_cfg, 0)),
@@ -977,7 +1020,7 @@ def shardkv_step(
     #    the persisted snapshot (they equal the state at the new base, because
     #    the boundary is the pre-tick apply cursor).
     inst = s.snap_installed_src >= 0  # [G, N]
-    comp = (s.base != pre.base) & ~inst & s.alive
+    comp = (s.base != pre_base) & ~inst & s.alive
     snap_cfg = jnp.where(comp, node_cfg, snap_cfg)
     snap_src = jnp.where(comp, node_src, snap_src)
     snap_phase = jnp.where(comp[..., None], phase, snap_phase)
@@ -1842,6 +1885,359 @@ def shardkv_step(
     )
 
 
+# ---------------------------------------------------------------------------
+# Packed deployment carry (ISSUE 11) — the real shardkv footprint
+# multiplier: per-deployment tensors up to [G, N, NS, NC] wide i32, plus G
+# embedded raft clusters. Same exact-or-wide contract as kv.py; the
+# deployment-level additions:
+#
+#   - the G group rafts pack with a service-rate index bound (a tick can
+#     append 1 no-op + 1 CONFIG + NS INSTALL + NS DELETE + NC client ops
+#     per node) and the shardkv op packing's cmd bound;
+#   - the controller cluster packs with its own (tiny) bounds via
+#     _ctrl_sim_cfg — announce values are the only commands it carries;
+#   - inter-group mailbox stamps (pull/GC/query) store tick-RELATIVE u8
+#     exactly like the in-group mailboxes (0 = empty; gated on the pull
+#     delay knobs);
+#   - per-shard counts bound by n_clients x seq (each accepted mutation is
+#     a distinct (client, seq)); bug_drop_dup_table breaks that bound by
+#     re-applying migrated ops, so it gates the run to the wide layout.
+# ---------------------------------------------------------------------------
+
+# Raft fields the service tick writes back into the group rafts / the
+# controller cluster (deployment-level violations live outside the rafts).
+_SKV_RAFT_WRITES = (
+    "log_term", "log_val", "log_len", "durable_len", "compact_floor",
+)
+_SKV_CTRL_WRITES = ("log_term", "log_val", "log_len", "durable_len")
+
+# Inter-group mailbox delivery stamps, stored tick-relative u8 when packed.
+_SKV_REL_FIELDS = (
+    "pull_req_t", "pull_rsp_t", "gcq_req_t", "gcq_rsp_t",
+    "cq_req_t", "cq_rsp_t",
+)
+
+
+@functools.lru_cache(maxsize=None)
+def shardkv_packed_layout(cfg: SimConfig, kcfg: ShardKvConfig) -> tuple:
+    """(group-raft PackedSpec, controller PackedSpec, service field ->
+    dtype table) for one static (SimConfig, ShardKvConfig) pair — the one
+    place the deployment widths derive (kv_packed_layout contract)."""
+    b = packed_bounds(cfg)
+    g, ns = kcfg.n_groups, kcfg.n_shards
+    nc, ncfg = kcfg.n_clients, kcfg.n_configs
+    seq_bound = min(b.tick, _SEQ_LIM - 1)
+    # appends per node per tick: leader no-op + CONFIG + NS installs + NS
+    # deletes + NC client ops (append_at re-derives room per append)
+    idx_bound = (nc + 2 * ns + 2) * b.tick + 1
+    cmd_bound = _pack_op(kcfg, nc - 1, _SEQ_LIM - 1, ns - 1, 7)
+    sp = packed_spec_for(cfg, index_bound=idx_bound, cmd_bound=cmd_bound)
+    # controller: at most 2 announce appends + a leader no-op per tick, and
+    # announce values are (slot, variant|gid) pairs
+    csp = packed_spec_for(
+        _ctrl_sim_cfg(cfg, kcfg), index_bound=3 * b.tick + 1,
+        cmd_bound=ncfg * max(g, 2),
+    )
+    seq = uint_for(seq_bound)
+    cnt = uint_for(nc * seq_bound)   # distinct (client, seq) per shard
+    obs = sint_for(nc * seq_bound)   # -1 sentinel + count range
+    num = uint_for(ncfg)             # config indices (>= 0 forms)
+    num_s = sint_for(ncfg)           # config indices with a -1 sentinel
+    gid = jnp.int8                   # group/replica ids (-1 capacity)
+    dts = {
+        "cfg_owner": gid,
+        "ctrl_w_frontier": csp.index,
+        "ctrl_w_stalled": BOOL,
+        "win_var": sint_for(max(g, 2)),
+        "flip_a": gid,
+        "flip_b": gid,
+        "slot_tick": sp.tick_signed,
+        "cmem": BOOL,
+        "ctrl_node_owner": gid,
+        "ctrl_maps": gid,
+        "node_src": gid,
+        "snap_src": gid,
+        "w_src": gid,
+        "cq_req_node": gid,
+        "cq_req_j": num,
+        "cq_rsp_j": num,
+        "cq_rsp_found": BOOL,
+        "cq_rsp_var": U8,
+        "applied": sp.index,
+        "node_cfg": num,
+        "phase": U8,
+        "key_hash": I32,
+        "key_count": cnt,
+        "last_seq": seq,
+        "snap_cfg": num,
+        "snap_phase": U8,
+        "snap_hash": I32,
+        "snap_count": cnt,
+        "snap_last_seq": seq,
+        "staged_cfg": num_s,
+        "staged_hash": I32,
+        "staged_count": cnt,
+        "staged_last_seq": seq,
+        "pull_req_cfg": num,
+        "pull_rsp_cfg": num,
+        "pull_rsp_hash": I32,
+        "pull_rsp_count": cnt,
+        "pull_rsp_last_seq": seq,
+        "gcq_req_cfg": num,
+        "gcq_rsp_cfg": num,
+        "clerk_seq": seq,
+        "clerk_out": BOOL,
+        "clerk_shard": uint_for(ns - 1),
+        "clerk_kind": U8,
+        "clerk_cfg": num,
+        "clerk_wrong": BOOL,
+        "clerk_acked": seq,
+        "clerk_get_lo": cnt,
+        "clerk_get_obs": obs,
+        "gets_done": sp.tick,
+        "clerk_sub": sp.tick,
+        "lat_hist": cnt,             # acked ops are distinct (client, seq)
+        "w_frontier": sp.index,
+        "w_cfg": num,
+        "w_phase": U8,
+        "w_hash": I32,
+        "w_count": cnt,
+        "w_last_seq": seq,
+        "frz_cfg": num_s,
+        "frz_hash": I32,
+        "frz_count": cnt,
+        "frz_last_seq": seq,
+        "truth_count": cnt,
+        "w_clerk_acked": seq,
+        "installs_done": I32,        # walked-marker totals: unbounded by
+        "deletes_done": I32,         # any per-op rule — full width
+        "max_cfg_lag": num,
+        "violations": I32,
+        "first_violation_tick": sp.tick_signed,
+    }
+    return sp, csp, dts
+
+
+class PackedShardKvState(NamedTuple):
+    """ShardKvState in the packed schema: G packed raft clusters, a packed
+    controller cluster, rel-u8 inter-group mailbox stamps, and every other
+    field narrowed per shardkv_packed_layout. cfg_tick stays i32 — its
+    bound rides the cfg_interval knob and the array is [NCFG] tiny."""
+
+    rafts: PackedClusterState        # every leaf has leading axis [G]
+    cfg_tick: jax.Array              # i32, kept wide
+    cfg_owner: jax.Array
+    ctrl: PackedClusterState
+    ctrl_w_frontier: jax.Array
+    ctrl_w_stalled: jax.Array
+    win_var: jax.Array
+    flip_a: jax.Array
+    flip_b: jax.Array
+    slot_tick: jax.Array
+    cmem: jax.Array
+    ctrl_node_owner: jax.Array
+    ctrl_maps: jax.Array
+    node_src: jax.Array
+    snap_src: jax.Array
+    w_src: jax.Array
+    cq_req_t: jax.Array              # rel u8 stamps (0 = empty)
+    cq_req_node: jax.Array
+    cq_req_j: jax.Array
+    cq_rsp_t: jax.Array
+    cq_rsp_j: jax.Array
+    cq_rsp_found: jax.Array
+    cq_rsp_var: jax.Array
+    applied: jax.Array
+    node_cfg: jax.Array
+    phase: jax.Array
+    key_hash: jax.Array
+    key_count: jax.Array
+    last_seq: jax.Array
+    snap_cfg: jax.Array
+    snap_phase: jax.Array
+    snap_hash: jax.Array
+    snap_count: jax.Array
+    snap_last_seq: jax.Array
+    staged_cfg: jax.Array
+    staged_hash: jax.Array
+    staged_count: jax.Array
+    staged_last_seq: jax.Array
+    pull_req_t: jax.Array
+    pull_req_cfg: jax.Array
+    pull_rsp_t: jax.Array
+    pull_rsp_cfg: jax.Array
+    pull_rsp_hash: jax.Array
+    pull_rsp_count: jax.Array
+    pull_rsp_last_seq: jax.Array
+    gcq_req_t: jax.Array
+    gcq_req_cfg: jax.Array
+    gcq_rsp_t: jax.Array
+    gcq_rsp_cfg: jax.Array
+    clerk_seq: jax.Array
+    clerk_out: jax.Array
+    clerk_shard: jax.Array
+    clerk_kind: jax.Array
+    clerk_cfg: jax.Array
+    clerk_wrong: jax.Array
+    clerk_acked: jax.Array
+    clerk_get_lo: jax.Array
+    clerk_get_obs: jax.Array
+    gets_done: jax.Array
+    clerk_sub: jax.Array
+    lat_hist: jax.Array
+    w_frontier: jax.Array
+    w_cfg: jax.Array
+    w_phase: jax.Array
+    w_hash: jax.Array
+    w_count: jax.Array
+    w_last_seq: jax.Array
+    frz_cfg: jax.Array
+    frz_hash: jax.Array
+    frz_count: jax.Array
+    frz_last_seq: jax.Array
+    truth_count: jax.Array
+    w_clerk_acked: jax.Array
+    installs_done: jax.Array
+    deletes_done: jax.Array
+    max_cfg_lag: jax.Array
+    violations: jax.Array
+    first_violation_tick: jax.Array
+
+
+def _rel_pack(st, t):
+    """Inter-group mailbox stamps -> tick-relative u8 (0 = empty). Every
+    live stamp is strictly in the future at the carry boundary (arrivals
+    are consumed and zeroed at stamp == t) and the pull-delay gate bounds
+    rel in [1, 254]."""
+    return {
+        f: jnp.where(getattr(st, f) > 0, getattr(st, f) - t, 0).astype(U8)
+        for f in _SKV_REL_FIELDS
+    }
+
+
+def _rel_unpack(p, t):
+    out = {}
+    for f in _SKV_REL_FIELDS:
+        r32 = getattr(p, f).astype(I32)
+        out[f] = jnp.where(r32 > 0, t + r32, 0)
+    return out
+
+
+def pack_shardkv_state(cfg: SimConfig, kcfg: ShardKvConfig,
+                       st: ShardKvState) -> PackedShardKvState:
+    sp, csp, dts = shardkv_packed_layout(cfg, kcfg)
+    t = st.rafts.tick[0]  # groups tick in lockstep
+    return PackedShardKvState(
+        rafts=jax.vmap(lambda r: pack_state(cfg, r, sp))(st.rafts),
+        ctrl=pack_state(_ctrl_sim_cfg(cfg, kcfg), st.ctrl, csp),
+        cfg_tick=st.cfg_tick,
+        **_rel_pack(st, t),
+        **pack_fields(st, dts),
+    )
+
+
+def unpack_shardkv_state(cfg: SimConfig, kcfg: ShardKvConfig,
+                         p: PackedShardKvState) -> ShardKvState:
+    sp, csp, dts = shardkv_packed_layout(cfg, kcfg)
+    rafts = jax.vmap(lambda r: unpack_state(cfg, r, sp))(p.rafts)
+    t = rafts.tick[0]
+    return ShardKvState(
+        rafts=rafts,
+        ctrl=unpack_state(_ctrl_sim_cfg(cfg, kcfg), p.ctrl, csp),
+        cfg_tick=p.cfg_tick,
+        **_rel_unpack(p, t),
+        **unpack_fields(p, dts),
+    )
+
+
+def shardkv_packed_layout_reason(cfg: SimConfig, kcfg: ShardKvConfig,
+                                 kn, skn,
+                                 ticks_needed: int) -> Optional[str]:
+    """None when the packed deployment schema is exact for this run — else
+    the wide-fallback reason (state.packed_layout_reason plus the
+    shardkv-layer gates on the inter-group network and the dup-table bug)."""
+    r = packed_layout_reason(cfg, kn, ticks_needed)
+    if r is not None:
+        return r
+    k = jax.tree.map(np.asarray, skn)
+    b = packed_bounds(cfg)
+    if (k.pull_delay_max > b.rel_stamp - 1).any():
+        return (
+            f"pull_delay_max {k.pull_delay_max} > {b.rel_stamp - 1}: "
+            "inter-group mailbox stamps are stored tick-relative in one u8"
+        )
+    if (k.pull_delay_min < 1).any():
+        return (
+            f"pull_delay_min {k.pull_delay_min} < 1: a same-tick stamp "
+            "would pack as an empty mailbox slot"
+        )
+    if k.bug_drop_dup_table.any():
+        return (
+            "bug_drop_dup_table re-applies migrated ops, so the per-shard "
+            "count bound (n_clients x seq) no longer holds"
+        )
+    return None
+
+
+def shardkv_step_packed(
+    cfg: SimConfig, kcfg: ShardKvConfig, pst: PackedShardKvState,
+    cluster_key: jax.Array, kn=None, skn=None,
+) -> PackedShardKvState:
+    """One deployment tick over the PACKED carry; with cfg.fuse_packed_step
+    the composition is per field group (the kv_step_packed contract): the G
+    group rafts and the controller cluster stay packed across their step
+    boundaries — only the fields the service writes (_SKV_RAFT_WRITES /
+    _SKV_CTRL_WRITES) re-pack, and a mode-off controller passes through
+    without ever widening."""
+    if kn is None:
+        _check_shardkv_cfg(cfg)
+        kn = cfg.knobs()
+    if skn is None:
+        skn = kcfg.knobs()
+    if not cfg.fuse_packed_step:
+        return pack_shardkv_state(cfg, kcfg, shardkv_step(
+            cfg, kcfg, unpack_shardkv_state(cfg, kcfg, pst), cluster_key,
+            kn, skn,
+        ))
+    sp, csp, dts = shardkv_packed_layout(cfg, kcfg)
+    ctrl_cfg = _ctrl_sim_cfg(cfg, kcfg)
+    pre = jax.vmap(lambda r: unpack_state(cfg, r, sp))(pst.rafts)
+    gkeys = jax.vmap(lambda i: jax.random.fold_in(cluster_key, _S_GROUP + i))(
+        jnp.arange(kcfg.n_groups)
+    )
+    ps = jax.vmap(
+        lambda r, k: pack_state(cfg, step_cluster(cfg, r, k, kn), sp)
+    )(pre, gkeys)
+    s = jax.vmap(lambda r: unpack_state(cfg, r, sp))(ps)
+    if kcfg.live_ctrler or kcfg.computed_ctrler:
+        pctrl = pack_state(ctrl_cfg, step_cluster(
+            cfg, unpack_state(ctrl_cfg, pst.ctrl, csp),
+            jax.random.fold_in(cluster_key, _S_CTRL), kn,
+        ), csp)
+    else:
+        pctrl = pst.ctrl
+    ctrl = unpack_state(ctrl_cfg, pctrl, csp)  # mode off: a pure DCE view
+    st = ShardKvState(
+        rafts=s, ctrl=ctrl, cfg_tick=pst.cfg_tick,
+        **_rel_unpack(pst, pre.tick[0]),
+        **unpack_fields(pst, dts),
+    )
+    nst = _shardkv_service_tick(cfg, kcfg, st, pre.alive, pre.base, s, ctrl,
+                                cluster_key, kn, skn)
+    pw = jax.vmap(lambda r: pack_state(cfg, r, sp))(nst.rafts)
+    rafts = ps._replace(**{f: getattr(pw, f) for f in _SKV_RAFT_WRITES})
+    if kcfg.live_ctrler or kcfg.computed_ctrler:
+        pwc = pack_state(ctrl_cfg, nst.ctrl, csp)
+        pctrl = pctrl._replace(
+            **{f: getattr(pwc, f) for f in _SKV_CTRL_WRITES}
+        )
+    return PackedShardKvState(
+        rafts=rafts, ctrl=pctrl, cfg_tick=nst.cfg_tick,
+        **_rel_pack(nst, nst.rafts.tick[0]),
+        **pack_fields(nst, dts),
+    )
+
+
 # ------------------------------------------------------------------- drivers
 class ShardKvFuzzReport(NamedTuple):
     violations: np.ndarray            # deployment-level bitmask
@@ -1877,16 +2273,20 @@ class ShardKvFuzzReport(NamedTuple):
 def _shardkv_program(
     static_cfg: SimConfig, static_kcfg: ShardKvConfig, n_clusters: int,
     mesh: Optional[Mesh], per_cluster_knobs: bool = False,
+    packed: bool = False,
 ):
     """One compiled program per static shape; every probability, interval,
     and bug mode is a runtime knob (uniform scalars — the fast layout; the
     per-cluster layout serves make_shardkv_sweep_fn). Before the knob split
     this layer rebuilt an uncached jit closure per make_shardkv_fuzz_fn
-    call, recompiling for every (config, call site) pair."""
+    call, recompiling for every (config, call site) pair. With ``packed``
+    the fori carry is the PackedShardKvState (ISSUE 11; separate cached
+    program, wide final returned)."""
     constraint = None
     if mesh is not None:
         constraint = NamedSharding(mesh, P(mesh.axis_names[0]))
     kn_ax = 0 if per_cluster_knobs else None
+    step_fn = shardkv_step_packed if packed else shardkv_step
 
     def run(seed, kn, skn, n_ticks) -> ShardKvState:
         base = jax.random.PRNGKey(seed)
@@ -1897,6 +2297,11 @@ def _shardkv_program(
             functools.partial(init_shardkv_cluster, static_cfg, static_kcfg),
             in_axes=(0, kn_ax, kn_ax),
         )(keys, kn, skn)
+        if packed:
+            states = jax.vmap(
+                functools.partial(pack_shardkv_state, static_cfg,
+                                  static_kcfg)
+            )(states)
         if constraint is not None:
             states = jax.lax.with_sharding_constraint(
                 states, jax.tree.map(lambda _: constraint, states)
@@ -1910,13 +2315,30 @@ def _shardkv_program(
 
         def body(_, carry):
             return jax.vmap(
-                functools.partial(shardkv_step, static_cfg, static_kcfg),
+                functools.partial(step_fn, static_cfg, static_kcfg),
                 in_axes=(0, 0, kn_ax, kn_ax),
             )(carry, keys, kn, skn)
 
-        return jax.lax.fori_loop(0, n_ticks, body, states)
+        final = jax.lax.fori_loop(0, n_ticks, body, states)
+        if packed:
+            final = jax.vmap(
+                functools.partial(unpack_shardkv_state, static_cfg,
+                                  static_kcfg)
+            )(final)
+        return final
 
     return jax.jit(run)
+
+
+def _shardkv_layout_telemetry(fn, cfg, kcfg, n_clusters, packed, layout,
+                              reason):
+    # here ``bytes_per_lane`` is bytes per DEPLOYMENT — this layer's lane
+    return attach_layout_telemetry(
+        fn, n_clusters, packed, layout, reason,
+        lambda: pack_shardkv_state(
+            cfg, kcfg, init_shardkv_cluster(cfg, kcfg, jax.random.PRNGKey(0))
+        ),
+    )
 
 
 def make_shardkv_fuzz_fn(
@@ -1925,19 +2347,25 @@ def make_shardkv_fuzz_fn(
     n_clusters: int,
     n_ticks: int,
     mesh: Optional[Mesh] = None,
+    pack_states: Optional[bool] = None,
 ):
-    """Build a jitted fn(seed) -> final batched ShardKvState."""
+    """Build a jitted fn(seed) -> final batched ShardKvState
+    (``pack_states`` follows the make_kv_fuzz_fn exact-or-wide contract)."""
     _check_shardkv_cfg(cfg)
-    prog = _shardkv_program(cfg.static_key(), kcfg.static_key(), n_clusters,
-                            mesh)
     kn = cfg.knobs()
     skn = kcfg.knobs()
+    reason = shardkv_packed_layout_reason(cfg, kcfg, kn, skn, n_ticks)
+    packed, layout = choose_layout_from_reason(reason, pack_states)
+    prog = _shardkv_program(cfg.static_key(), kcfg.static_key(), n_clusters,
+                            mesh, False, packed)
     ticks = jnp.asarray(n_ticks, jnp.int32)
     # uint32 coercion: keep the (seed, cluster_id) replay contract under x64
-    return FuzzProgram(
+    fn = FuzzProgram(
         prog,
         lambda seed: (jnp.asarray(seed, jnp.uint32), kn, skn, ticks),
     )
+    return _shardkv_layout_telemetry(fn, cfg, kcfg, n_clusters, packed,
+                                     layout, reason)
 
 
 def _validate_shardkv_knobs(skn) -> None:
@@ -1976,10 +2404,13 @@ def make_shardkv_sweep_fn(
     n_clusters: int,
     n_ticks: int,
     mesh: Optional[Mesh] = None,
+    pack_states: Optional[bool] = None,
 ):
     """Like make_shardkv_fuzz_fn, but every deployment runs its own raft AND
     service knobs — reconfiguration cadence, workload mix, inter-group
-    network, and the planted migration bugs become per-deployment data."""
+    network, and the planted migration bugs become per-deployment data.
+    The layout gate sees the whole knob matrix (e.g. any deployment running
+    bug_drop_dup_table sends the sweep to the wide carry)."""
     from madraft_tpu.tpusim.engine import (
         _validate_knobs,
         validate_service_raft_knobs,
@@ -1996,15 +2427,19 @@ def make_shardkv_sweep_fn(
             "bug_rotate_tiebreak (sweep knob) needs kcfg.computed_ctrler "
             "— without the computed controller it would silently do nothing"
         )
+    reason = shardkv_packed_layout_reason(cfg, kcfg, knobs, sknobs, n_ticks)
+    packed, layout = choose_layout_from_reason(reason, pack_states)
     prog = _shardkv_program(cfg.static_key(), kcfg.static_key(), n_clusters,
-                            mesh, per_cluster_knobs=True)
+                            mesh, True, packed)
     kn = knobs.broadcast(n_clusters)
     skn = sknobs.broadcast(n_clusters)
     ticks = jnp.asarray(n_ticks, jnp.int32)
-    return FuzzProgram(
+    fn = FuzzProgram(
         prog,
         lambda seed: (jnp.asarray(seed, jnp.uint32), kn, skn, ticks),
     )
+    return _shardkv_layout_telemetry(fn, cfg, kcfg, n_clusters, packed,
+                                     layout, reason)
 
 
 def shardkv_report(final: ShardKvState) -> ShardKvFuzzReport:

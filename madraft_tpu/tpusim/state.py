@@ -65,8 +65,15 @@ U32 = jnp.uint32
 #   2 — the packed cold-state schema below (PackedClusterState): narrow
 #       dtypes derived from config.packed_bounds, bitfield words for
 #       role/alive/adjacency/votes, tick-relative u8 mailbox stamps.
+#   3 — the service-layer packed schemas (ISSUE 11): kv/ctrler/shardkv
+#       carries pack under the same exact-or-wide rule (PackedKvState /
+#       PackedCtrlerState / PackedShardKvState in their own modules), with
+#       the embedded raft group's index/cmd dtypes re-derived for the
+#       service append rate via packed_spec_for (a service tick can append
+#       up to n_clients (+ marker) entries per node, so the raft layer's
+#       2-per-tick index bound does not hold there).
 # Replay/explain JSON carries this plus the layout the run actually used.
-STATE_SCHEMA_VERSION = 2
+STATE_SCHEMA_VERSION = 3
 
 
 class ClusterState(NamedTuple):
@@ -361,18 +368,31 @@ def _sint_for(bound: int):
 
 
 @functools.lru_cache(maxsize=None)
-def packed_spec(cfg: SimConfig) -> PackedSpec:
+def packed_spec_for(cfg: SimConfig, index_bound: Optional[int] = None,
+                    cmd_bound: Optional[int] = None) -> PackedSpec:
+    """PackedSpec with the index/cmd bounds optionally OVERRIDDEN — the
+    service-layer hook (ISSUE 11): a kv/ctrler/shardkv tick appends up to
+    n_clients client entries (plus marker entries) per node, so the raft
+    layer's 2-per-tick index bound and n*(T+1) cmd bound do not hold for
+    the raft group embedded in a service carry. Each service module derives
+    its own bounds from its static config and packs its raft sub-state with
+    this spec; the default (both None) is exactly the raft-layer spec."""
     b = packed_bounds(cfg)
-    cmd_dt = _uint_for(b.cmd + 1)  # + 1 reserves a distinct NOOP sentinel
+    cmd_dt = _uint_for((b.cmd if cmd_bound is None else cmd_bound) + 1)
+    # + 1 reserves a distinct NOOP sentinel
     return PackedSpec(
         tick=_uint_for(b.tick),
         term=_uint_for(b.term),
-        index=_uint_for(b.index),
+        index=_uint_for(b.index if index_bound is None else index_bound),
         cmd=cmd_dt,
         noop_code=int(np.iinfo(cmd_dt).max),
         tick_signed=_sint_for(b.tick),
         event=_uint_for(b.event),
     )
+
+
+def packed_spec(cfg: SimConfig) -> PackedSpec:
+    return packed_spec_for(cfg)
 
 
 class PackedClusterState(NamedTuple):
@@ -436,12 +456,13 @@ class PackedClusterState(NamedTuple):
     # --- metrics plane (ISSUE 10; zero-size with cfg.metrics off) ---
     log_tick: jax.Array             # tick dtype: per-entry submit stamps
     shadow_sub: jax.Array           # tick dtype: this-tick shadow stamps
-    lat_hist: jax.Array             # index dtype: bucket counts — on the
-    #                                 packed (raft) path each bucket counts
-    #                                 committed injected commands, bounded
-    #                                 by the shadow length's index bound;
-    #                                 service layers can exceed it but
-    #                                 never pack (their carries are wide)
+    lat_hist: jax.Array             # index dtype: bucket counts — each
+    #                                 bucket counts committed/acked ops,
+    #                                 bounded by the spec's index bound (the
+    #                                 raft bound on the raft path; the
+    #                                 service layers pack with their own
+    #                                 re-derived index bound, which covers
+    #                                 their clerk-ack folds — ISSUE 11)
     ev_counts: jax.Array            # event dtype (narrow row; see
     #                                 packed_bounds.event)
 
@@ -465,10 +486,14 @@ def _unpack_bool_rows(rows: jax.Array, n: int) -> jax.Array:
     ).astype(BOOL)
 
 
-def pack_state(cfg: SimConfig, s: ClusterState) -> PackedClusterState:
+def pack_state(cfg: SimConfig, s: ClusterState,
+               sp: Optional[PackedSpec] = None) -> PackedClusterState:
     """Wide -> packed, exact for every value within config.packed_bounds.
-    Written per-cluster; the engine vmaps it over the lane axis."""
-    sp = packed_spec(cfg)
+    Written per-cluster; the engine vmaps it over the lane axis. ``sp``
+    lets a service layer substitute its re-derived spec (packed_spec_for);
+    None keeps the raft-layer derivation."""
+    if sp is None:
+        sp = packed_spec(cfg)
     n = cfg.n_nodes
     t = s.tick
     idx = jnp.arange(n, dtype=U32)
@@ -546,10 +571,12 @@ def pack_state(cfg: SimConfig, s: ClusterState) -> PackedClusterState:
     )
 
 
-def unpack_state(cfg: SimConfig, p: PackedClusterState) -> ClusterState:
+def unpack_state(cfg: SimConfig, p: PackedClusterState,
+                 sp: Optional[PackedSpec] = None) -> ClusterState:
     """Packed -> wide (the widen-on-use boundary): exact inverse of
     pack_state, restoring the i32/bool dtypes step_cluster runs on."""
-    sp = packed_spec(cfg)
+    if sp is None:
+        sp = packed_spec(cfg)
     n = cfg.n_nodes
     t = p.tick.astype(I32)
     idx = jnp.arange(n, dtype=U32)
@@ -675,3 +702,45 @@ def tree_bytes(tree) -> int:
     measurement behind the ``state_hbm_bytes``/``bytes_per_lane`` summary
     telemetry (actual buffer sizes, never a schema estimate)."""
     return int(sum(x.nbytes for x in jax.tree.leaves(tree)))
+
+
+def abstract_bytes(tree) -> int:
+    """tree_bytes over a ``jax.eval_shape`` result: the byte total of the
+    buffers a program WOULD carry (shape x itemsize — identical to the
+    live-buffer number for dense arrays) without instantiating them. The
+    service fuzz entry points use it to report their resident-carry
+    footprint at build time instead of paying an extra device allocation."""
+    return int(sum(
+        int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize
+        for x in jax.tree.leaves(tree)
+    ))
+
+
+# Public aliases for the service-layer packed schemas (ISSUE 11): each
+# service module derives its own field widths from config.packed_bounds
+# through these, so the exact-or-wide derivation has one implementation.
+uint_for = _uint_for
+sint_for = _sint_for
+
+
+def pack_fields(tree, dtypes: dict) -> dict:
+    """The cast-only share of a service-layer pack: ``{field: narrow
+    array}`` for every (name, dtype) entry — bool leaves pass through
+    (already 1 byte), everything else downcasts to its derived dtype.
+    Exact for in-bounds values by construction; the per-layer layout gate
+    is what guarantees in-bounds."""
+    out = {}
+    for f, dt in dtypes.items():
+        x = getattr(tree, f)
+        out[f] = x if dt == BOOL else x.astype(dt)
+    return out
+
+
+def unpack_fields(tree, dtypes: dict) -> dict:
+    """Exact inverse of pack_fields: widen every cast field back to the
+    i32/bool dtypes the service tick runs on."""
+    out = {}
+    for f, dt in dtypes.items():
+        x = getattr(tree, f)
+        out[f] = x if dt == BOOL else x.astype(I32)
+    return out

@@ -61,6 +61,14 @@ enable_compilation_cache(os.path.join(os.path.dirname(__file__), "..", ".jax_cac
 # wrap themselves in no_persistent_cache() below; everything else caches.
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy compile/runtime tests excluded from tier-1 "
+        "(-m 'not slow'); run explicitly or via -m slow",
+    )
+
+
 def pytest_collection_modifyitems(session, config, items):
     """Run the shardkv module FIRST (file order is otherwise alphabetical).
 

@@ -10,6 +10,7 @@ deliberately broken quorum (majority_override=2) that both backends support.
 """
 
 import pathlib
+import shutil
 import subprocess
 
 import numpy as np
@@ -28,6 +29,18 @@ def _ensure_binary(target: str) -> pathlib.Path:
     srcs = list((ROOT / "cpp").rglob("*.cpp")) + list((ROOT / "cpp").rglob("*.h"))
     newest = max(p.stat().st_mtime for p in srcs)
     if not binary.exists() or binary.stat().st_mtime < newest:
+        # Missing TOOLCHAIN -> clean skip (the test_cpp_suite.py treatment:
+        # cmake-less containers run the rest of the suite green instead of
+        # carrying 9 documented failures). A toolchain that is present but
+        # FAILS still fails loudly below — skipping would silently green a
+        # broken C++ change. The in-process simcore bridge tests are
+        # unaffected either way (they use bridge.py's direct-g++ fallback).
+        missing = [t for t in ("cmake", "ninja") if shutil.which(t) is None]
+        if missing:
+            pytest.skip(
+                f"cmake-built C++ replay binaries need cmake+ninja; "
+                f"missing: {', '.join(missing)}"
+            )
         for cmd in (
             ["cmake", "-S", str(ROOT / "cpp"), "-B", str(BUILD), "-G", "Ninja"],
             ["ninja", "-C", str(BUILD), target],

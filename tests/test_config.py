@@ -26,9 +26,11 @@ SIM_FOLDED = {
 
 def test_simconfig_fields_all_reach_the_program():
     # static_key's fields (max_lane_ticks shapes the packed dtypes;
-    # metrics shapes the ISSUE-10 metric arrays — zero-size when off)
+    # metrics shapes the ISSUE-10 metric arrays — zero-size when off;
+    # fuse_packed_step selects the ISSUE-11 per-field-group composition,
+    # its own cached program)
     static = {"n_nodes", "log_cap", "ae_max", "bug", "max_lane_ticks",
-              "metrics"}
+              "metrics", "fuse_packed_step"}
     knob_names = set(Knobs._fields)
     for f in dataclasses.fields(SimConfig):
         if f.name in SIM_DOC_ONLY or f.name in static:

@@ -263,8 +263,8 @@ def test_explain_chrome_gains_liveness_counters(tmp_path):
 def test_service_cli_metrics_plumbing():
     # shardkv-fuzz builds its SimConfig from scratch — the --metrics flag
     # must be carried explicitly (a dropped flag silently reports nothing);
-    # ctrler-fuzz surfaces events WITHOUT a latency dict (its clerk carries
-    # no latency stamps yet — documented in CtrlerFuzzReport)
+    # ctrler-fuzz now surfaces a REAL latency dict alongside the events
+    # (the ISSUE 11 clerk_sub satellite closed PR 10's events-only gap)
     rc, out = run_cli(["shardkv-fuzz", "--clusters", "2", "--ticks", "160",
                        "--metrics", "--nodes", "3"])
     d = json.loads(out.strip().splitlines()[-1])
@@ -273,7 +273,8 @@ def test_service_cli_metrics_plumbing():
     rc, out = run_cli(["ctrler-fuzz", "--clusters", "8", "--ticks", "128",
                        "--metrics"])
     d = json.loads(out.strip().splitlines()[-1])
-    assert "latency" not in d and "events" in d, d.keys()
+    assert "latency" in d and d["latency"]["ops"] > 0, d.keys()
+    assert "events" in d
     assert d["events"]["elections_won"] > 0
 
 
