@@ -491,6 +491,86 @@ def bench_latency(n_clusters: int, n_ticks: int) -> dict:
     }
 
 
+def bench_tail_attrib(n_clusters: int, n_ticks: int) -> dict:
+    """Tail-latency attribution A/B (ISSUE 12): two kv-clerk legs whose
+    fault axes stress DIFFERENT phases, with the dominant phase (largest
+    exact tick share of the decomposition) pinned per leg — the per-phase
+    readout ROADMAP item 1's optimization matrix will use to show which
+    phase each knob moves:
+
+    - ``storm``      an election storm — leaders keep dying (p_crash 0.2,
+                     max_dead 2) and elections are slow (25-50-tick
+                     timeouts) over a clean fast network, so ops spend
+                     the tail WAITING FOR A LEADER. Pinned dominant:
+                     leader_wait (election wait). Measured 81-88% of
+                     latency ticks across seeds (round 12, CPU).
+    - ``durability`` the lossy-persistence axis under a degraded network —
+                     rare crashes (so few elections) but fsync_every 8 +
+                     p_lose_unsynced 1.0 re-loses acked suffixes, and
+                     loss 0.2 / ae_max 1 / delay_max 5 slow replication,
+                     so ops spend the tail REPLICATING. Pinned dominant:
+                     replicate (replication wait). Measured ~80%.
+
+    The raw storm_profiles() pair does NOT separate this way (its
+    durability profile crashes 2x harder than its storm, so BOTH tails are
+    election-bound) — these legs are tuned so each axis isolates its
+    phase, which is exactly the attribution the plane exists to show."""
+    from madraft_tpu.tpusim.config import LATENCY_PHASES
+    from madraft_tpu.tpusim.kv import KvConfig, kv_fuzz
+    from madraft_tpu.tpusim.metrics import merge_worst_registers
+
+    legs = {
+        "storm": (
+            SimConfig(
+                n_nodes=5, p_client_cmd=0.0, compact_at_commit=False,
+                p_crash=0.2, p_restart=0.3, max_dead=2, loss_prob=0.01,
+                election_timeout_min=25, election_timeout_max=50,
+                metrics=True,
+            ),
+            "leader_wait",
+        ),
+        "durability": (
+            SimConfig(
+                n_nodes=5, p_client_cmd=0.0, compact_at_commit=False,
+                p_crash=0.02, p_restart=0.5, max_dead=1,
+                fsync_every=8, p_lose_unsynced=1.0,
+                loss_prob=0.2, ae_max=1, delay_max=5, metrics=True,
+            ),
+            "replicate",
+        ),
+    }
+    kcfg = KvConfig(p_get=0.3, p_put=0.2)
+    out = {"n_clusters": n_clusters, "n_ticks": n_ticks}
+    ok = True
+    for name, (cfg, want) in legs.items():
+        t0 = time.perf_counter()
+        rep = kv_fuzz(cfg, kcfg, 12345, n_clusters, n_ticks)
+        wall = time.perf_counter() - t0
+        pt = rep.phase_ticks.sum(axis=0)
+        total = max(int(pt.sum()), 1)
+        dominant = LATENCY_PHASES[int(pt.argmax())]
+        worst = merge_worst_registers(
+            rep.worst_lat, rep.worst_phases, rep.worst_key,
+            rep.worst_client, rep.worst_sub,
+        )
+        leg_pass = dominant == want
+        ok = ok and leg_pass
+        out[name] = {
+            "acked_ops": int(rep.acked_ops.sum()),
+            "phase_share": {
+                n: round(int(pt[i]) / total, 4)
+                for i, n in enumerate(LATENCY_PHASES)
+            },
+            "dominant_phase": dominant,
+            "pinned_dominant": want,
+            "pass": leg_pass,
+            "worst_op": worst,
+            "wall_s": round(wall, 3),
+        }
+    out["pass"] = ok
+    return out
+
+
 def bench_state_footprint() -> dict:
     """Per-lane resident-state footprint (ISSUE 9), wide vs packed, from
     LIVE device buffers (never a schema estimate): the lanes-per-HBM story.
@@ -796,6 +876,9 @@ def main() -> None:
     # latency-tail row (ISSUE 10): p50/p99 + the p99 regression gate on the
     # storm profile, same //4 sizing as the other secondary rows
     latency = bench_latency(max(256, n_clusters // 4), n_ticks)
+    # tail-attribution A/B (ISSUE 12): fixed scale on purpose — the pinned
+    # dominant-phase assertions were measured at this shape across seeds
+    tail_attrib = bench_tail_attrib(64, 600)
     kv = bench_kv(max(256, n_clusters // 4), max(256, n_ticks // 2))
     # //4 like kv: 512 clusters under-fill the chip for this layer
     # (2.2M steps/s at 512 vs 3.4M at 1024, measured in the r03d soak)
@@ -888,6 +971,9 @@ def main() -> None:
                     "latency_p99_ticks": latency["latency_p99_ticks"],
                     "tail_gate_pass": latency["tail_gate"]["pass"],
                     "latency": latency,
+                    # phase-attribution A/B + dominant-phase pin (ISSUE 12)
+                    "tail_attrib_pass": tail_attrib["pass"],
+                    "tail_attrib": tail_attrib,
                     "device": str(jax.devices()[0]),
                     **({"degraded": degraded} if degraded else {}),
                 },

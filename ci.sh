@@ -174,14 +174,20 @@ print(f"coverage smoke: {summary['retired_violating']} violating, "
       f"(mutated {cov['refills_mutated']}, fresh {cov['refills_fresh']})")
 PY
 
-# metrics smoke (ISSUE 10): the on-device metrics plane through the pool.
-# The planted-bug leg must report nonzero histogram mass (summary latency
-# dict + per-row latency_hist/events columns), and the `stats` verb must
-# render the captured stream; the clean leg is the latency-tail REGRESSION
-# GATE — the durability profile's clean p99 must stay under the pinned
-# bound (bench.py's storm tail_gate analogue; 255 ticks measured at this
-# shape in round 10, 511 = one log-spaced bucket of headroom, so only a
-# real distribution shift trips it). Metrics are a static program flag
+# metrics smoke (ISSUE 10 + 12): the on-device metrics plane through the
+# pool. The planted-bug leg must report nonzero histogram mass (summary
+# latency dict + per-row latency_hist/events columns), the attribution
+# plane (latency.phases keyed by phase name, per-row latency_phases, a
+# worst_op register) must ride along with the phase-sum invariant intact,
+# the packed layout must hold the METRICS-ON bytes bound (3585 B/lane
+# measured at this shape in round 12 vs 3417 pre-attribution; the 3600
+# ceiling catches attribution-axis growth the way the metrics-off 2800
+# gate catches re-widening), and the `stats` verb must render the captured
+# stream; the clean leg is the latency-tail REGRESSION GATE — the
+# durability profile's clean p99 must stay under the pinned bound
+# (bench.py's storm tail_gate analogue; 255 ticks measured at this shape
+# in round 10, 511 = one log-spaced bucket of headroom, so only a real
+# distribution shift trips it). Metrics are a static program flag
 # (SimConfig.metrics joins static_key), so these legs select their own
 # cached programs and the metrics-off pool smoke above stays bit-identical.
 MADTPU_PLATFORM=cpu python - <<'PY'
@@ -189,6 +195,7 @@ import contextlib, io, json, tempfile
 from madraft_tpu.__main__ import main
 
 DURABILITY_P99_BOUND = 511  # ticks; clean-leg p99 measured 255 (round 10)
+METRICS_BYTES_PER_LANE_BOUND = 3600  # measured 3585 (round 12); off = 2597
 
 buf = io.StringIO()
 with contextlib.redirect_stdout(buf):
@@ -205,6 +212,22 @@ assert lat["ops"] > 0, lat
 assert summary["events"]["commit_advances"] > 0, summary["events"]
 assert all("latency_hist" in r and "events" in r for r in rows), \
     "JSONL rows missing the metrics columns"
+# attribution plane (ISSUE 12): phase rows + worst op, summary and rows
+assert summary["state_layout"] == "packed", summary
+assert summary["bytes_per_lane"] <= METRICS_BYTES_PER_LANE_BOUND, (
+    f"metrics-on packed state grew: {summary['bytes_per_lane']} B/lane > "
+    f"{METRICS_BYTES_PER_LANE_BOUND} (measured 3585)"
+)
+phases = lat["phases"]
+assert set(phases) == {"leader_wait", "replicate", "apply", "ack"}, phases
+assert all(sum(d["hist"]) == lat["ops"] for d in phases.values()), \
+    "each phase row must fold one sample per acked op"
+assert sum(d["ticks_total"] for d in phases.values()) == lat["ticks_total"], \
+    "phase tick totals must sum to the e2e latency total exactly"
+w = summary["worst_op"]
+assert w and sum(w["phases"].values()) == w["latency_ticks"], w
+assert all("latency_phases" in r and "worst_op" in r for r in rows), \
+    "JSONL rows missing the attribution columns"
 # cross-surface mass accounting: the summary merges the retired rows PLUS
 # the final harvest's in-flight lanes, so the independent per-row columns
 # must carry nonzero mass and never exceed the merged total

@@ -239,6 +239,43 @@ def _report_json(rep, extra=None):
         from madraft_tpu.tpusim.metrics import latency_summary
 
         out["latency"] = latency_summary(rep.lat_hist.sum(axis=0))
+    if getattr(rep, "phase_hist", None) is not None:
+        # attribution plane (ISSUE 12): phase breakdown keyed by name, the
+        # per-key/per-client axes (kv/shardkv reports), and the report's
+        # global worst op (the max over the per-cluster registers)
+        from madraft_tpu.tpusim.metrics import (
+            latency_summary,
+            merge_worst_registers,
+            phases_summary,
+        )
+
+        out["latency"]["phases"] = phases_summary(
+            rep.phase_hist.sum(axis=0), rep.phase_ticks.sum(axis=0)
+        )
+        out["latency"]["ticks_total"] = int(rep.lat_ticks.sum())
+        retries = getattr(rep, "client_retries", None)
+        r = retries.sum(axis=0) if retries is not None else None
+        for field, key in (("key_hist", "by_key"),
+                           ("client_hist", "by_client")):
+            axes = getattr(rep, field, None)
+            if axes is not None:
+                merged = axes.sum(axis=0)  # [rows, HB]
+                # a client with zero acked ops but nonzero retries is the
+                # MOST interesting per-client row (a permanent NotLeader
+                # hunt: retries >> ops) — it must not vanish from the axis
+                want_c = key == "by_client" and r is not None
+                out["latency"][key] = {
+                    str(k): latency_summary(merged[k])
+                    for k in range(merged.shape[0])
+                    if merged[k].sum() or (want_c and r[k])
+                }
+                if want_c:
+                    for k, d in out["latency"][key].items():
+                        d["retries"] = int(r[int(k)])
+        out["worst_op"] = merge_worst_registers(
+            rep.worst_lat, rep.worst_phases, rep.worst_key,
+            rep.worst_client, rep.worst_sub,
+        )
     if getattr(rep, "ev_counts", None) is not None:
         from madraft_tpu.tpusim.metrics import event_summary
 
@@ -652,14 +689,44 @@ def cmd_explain(args):
     return 0
 
 
-def _collect_stats(streams):
+class _StatsMerge:
+    """Everything `stats` pulls out of the input streams (ISSUE 10 + 12):
+    merged e2e histogram, event counters, per-source-file counts (the
+    exit-2 UX: name WHICH inputs carried nothing), phase histograms merged
+    BY NAME, the per-key/per-client axes, and the global worst op."""
+
+    def __init__(self, hist_buckets: int, n_events: int):
+        import numpy as np
+
+        self.hist = np.zeros(hist_buckets, np.int64)
+        self.events = np.zeros(n_events, np.int64)
+        self.seen = 0
+        self.seen_per_stream: list = []
+        self.phases: dict = {}    # name -> (hist row, ticks_total)
+        self.by_key: dict = {}    # key -> hist row
+        self.by_client: dict = {}  # client -> hist row
+        self.worst = None
+
+
+def _merge_axis(table: dict, key, hist_row) -> None:
+    import numpy as np
+
+    row = np.asarray(hist_row, np.int64)
+    if key in table:
+        table[key] = table[key] + row
+    else:
+        table[key] = row
+
+
+def _collect_stats(streams) -> _StatsMerge:
     """Pull every histogram/counter the metrics plane ever writes out of
     report JSON streams (one list of lines per input file): fuzz/sweep
     reports ({"latency": {...}, "events": {...}}), pool summaries (same
     keys), and pool JSONL rows ({"latency_hist": [...], "events": {...}}).
-    Returns (hist, events, rows_seen) with hist/events merged by plain
-    addition — the fixed bucket layout is what makes cross-file merging
-    correct.
+    Everything merges by plain addition over the fixed bucket layout;
+    phase rows and the by_key/by_client axes merge BY NAME/id, so layers
+    with different phase sets (shardkv's migration row) and different key
+    alphabets coexist; worst ops merge by the deterministic max rule.
 
     A pool stream carries BOTH per-row histograms and a summary that
     already merged them (plus the in-flight lanes' rows) — counting both
@@ -670,10 +737,9 @@ def _collect_stats(streams):
     import numpy as np
 
     from madraft_tpu.tpusim.config import HIST_BUCKETS, METRIC_EVENTS
+    from madraft_tpu.tpusim.metrics import merge_worst
 
-    hist = np.zeros(HIST_BUCKETS, np.int64)
-    events = np.zeros(len(METRIC_EVENTS), np.int64)
-    seen = 0
+    m = _StatsMerge(HIST_BUCKETS, len(METRIC_EVENTS))
     for lines in streams:
         docs = []
         for raw in lines:
@@ -690,13 +756,31 @@ def _collect_stats(streams):
             isinstance(d.get("latency"), dict) and d["latency"].get("hist")
             for d in docs
         )
+        stream_seen = 0
         for doc in docs:
             lat = doc.get("latency")
             row_hist = None
+            row_phases = None
+            from_summary = False
             if isinstance(lat, dict) and lat.get("hist"):
                 row_hist = lat["hist"]
+                from_summary = True
+                if isinstance(lat.get("phases"), dict):
+                    row_phases = {
+                        name: (d.get("hist"), d.get("ticks_total", 0))
+                        for name, d in lat["phases"].items()
+                        if isinstance(d, dict)
+                    }
             elif use_rows and doc.get("latency_hist"):
                 row_hist = doc["latency_hist"]
+                if isinstance(doc.get("latency_phases"), dict):
+                    # pool rows carry the raw phase rows only (no exact
+                    # tick totals); the merged table shows ticks_total 0
+                    # for rows-only inputs rather than estimating it
+                    row_phases = {
+                        name: (h, 0)
+                        for name, h in doc["latency_phases"].items()
+                    }
             # an events-ONLY report (the ctrler layer counts events but
             # carries no latency stamps) still merges — but a pool row
             # suppressed by its own stream's summary contributes neither
@@ -707,14 +791,40 @@ def _collect_stats(streams):
             )
             if row_hist is None and not ev_only:
                 continue
-            seen += 1
+            m.seen += 1
+            stream_seen += 1
             if row_hist is not None and len(row_hist) == HIST_BUCKETS:
-                hist += np.asarray(row_hist, np.int64)
+                m.hist += np.asarray(row_hist, np.int64)
+            if row_phases:
+                for name, (h, ticks) in row_phases.items():
+                    if h is None or len(h) != HIST_BUCKETS:
+                        continue
+                    old_h, old_t = m.phases.get(
+                        name, (np.zeros(HIST_BUCKETS, np.int64), 0)
+                    )
+                    m.phases[name] = (
+                        old_h + np.asarray(h, np.int64), old_t + int(ticks)
+                    )
+            if from_summary or use_rows:
+                for src, table in (("by_key", m.by_key),
+                                   ("by_client", m.by_client)):
+                    ax = lat.get(src) if isinstance(lat, dict) else None
+                    if isinstance(ax, dict):
+                        for k, d in ax.items():
+                            if isinstance(d, dict) and d.get("hist"):
+                                _merge_axis(table, str(k), d["hist"])
+                w = doc.get("worst_op")
+                if isinstance(w, dict):
+                    # a pool row's id rides the row, not the worst dict —
+                    # pass it so the deterministic tie-break sees real ids
+                    m.worst = merge_worst(m.worst, w,
+                                          b_id=doc.get("cluster_id"))
             row_ev = doc.get("events")
             if isinstance(row_ev, dict):
                 for i, name in enumerate(METRIC_EVENTS):
-                    events[i] += int(row_ev.get(name, 0))
-    return hist, events, seen
+                    m.events[i] += int(row_ev.get(name, 0))
+        m.seen_per_stream.append(stream_seen)
+    return m
 
 
 def cmd_stats(args):
@@ -743,40 +853,107 @@ def cmd_stats(args):
             except OSError as e:
                 print(f"stats: {e}", file=sys.stderr)
                 raise SystemExit(2)
-    hist, events, seen = _collect_stats(streams)
-    if not seen:
-        print("stats: no metrics found in the input — was the run made "
+    m = _collect_stats(streams)
+    empty = [p for p, n in zip(paths, m.seen_per_stream) if n == 0]
+    if not m.seen:
+        # name the specific inputs so a glob with one stale metrics-off
+        # file reads differently from an entirely metrics-free run
+        which = ", ".join("stdin" if p == "-" else p for p in empty)
+        print(f"stats: no metrics found in: {which} — was the run made "
               "with --metrics?", file=sys.stderr)
         return 2
-    lat = latency_summary(hist)
+    if empty:
+        # mixed input: render what was found, but say which files carried
+        # no metrics blocks (a silently-skipped file reads as merged)
+        which = ", ".join("stdin" if p == "-" else p for p in empty)
+        print(f"stats: warning: no metrics blocks in: {which} (merged the "
+              f"other {m.seen} source(s))", file=sys.stderr)
+    lat = latency_summary(m.hist)
     try:
-        _print_stats(args, hist, events, seen, lat, METRIC_EVENTS,
-                     render_histogram)
+        _print_stats(args, m, lat, METRIC_EVENTS, render_histogram)
     except BrokenPipeError:  # e.g. `stats ... | head` — not an error
         pass
     return 0
 
 
-def _print_stats(args, hist, events, seen, lat, METRIC_EVENTS,
-                 render_histogram):
-    print(f"sources merged: {seen}")
+def _top_axis(table: dict, top: int) -> list:
+    """The top-N rows of a per-key/per-client axis, worst tail first
+    (p99 desc, then ops desc) — the hot-key-skew readout."""
+    from madraft_tpu.tpusim.metrics import latency_summary
+
+    rows = [(k, latency_summary(h)) for k, h in table.items()]
+    rows.sort(key=lambda kv: (-(kv[1]["p99_ticks"] or 0), -kv[1]["ops"]))
+    return rows[:top]
+
+
+def _print_stats(args, m, lat, METRIC_EVENTS, render_histogram):
+    from madraft_tpu.tpusim.metrics import latency_summary
+
+    print(f"sources merged: {m.seen}")
     print(f"latency: ops={lat['ops']} p50={lat['p50_ticks']} "
           f"p99={lat['p99_ticks']} (ticks; log-spaced buckets, quantile = "
           f"bucket upper edge)")
-    for line in render_histogram(hist):
+    for line in render_histogram(m.hist):
         print(line)
-    if events.any():
+    if m.phases:
+        # the attribution table (ISSUE 12): where the tail actually lives.
+        # share = this phase's exact tick total over all phases' (0 when
+        # the inputs carried only raw rows, which lack tick totals).
+        total_ticks = sum(t for _, t in m.phases.values())
+        print("phases (sum of phase durations == e2e latency, per op):")
+        width = max(len(n) for n in m.phases)
+        for name, (h, ticks) in m.phases.items():
+            d = latency_summary(h)
+            share = (f"  {100.0 * ticks / total_ticks:5.1f}% of ticks"
+                     if total_ticks else "")
+            print(f"  {name:<{width}}  ops={d['ops']:>8}  "
+                  f"p50={str(d['p50_ticks']):>6} p99={str(d['p99_ticks']):>6}"
+                  f"{share}")
+    for flag, label, table in (("by_key", "key", m.by_key),
+                               ("by_client", "client", m.by_client)):
+        if getattr(args, flag, False) and table:
+            print(f"top {label}s by p99:")
+            for k, d in _top_axis(table, args.top):
+                print(f"  {label} {k:>4}  ops={d['ops']:>8}  "
+                      f"p50={str(d['p50_ticks']):>6} "
+                      f"p99={str(d['p99_ticks']):>6}")
+    if m.worst is not None:
+        ph = ", ".join(f"{k}={v}" for k, v in m.worst["phases"].items()
+                       if v)
+        print(f"worst op: {m.worst['latency_ticks']} ticks "
+              f"(submit tick {m.worst['submit_tick']}, "
+              f"key {m.worst['key']}, client {m.worst['client']}"
+              + (f", cluster {m.worst['cluster_id']}"
+                 if "cluster_id" in m.worst else "")
+              + f") — {ph or 'all phases 0'}")
+    if m.events.any():
         print("events:")
         width = max(len(n) for n in METRIC_EVENTS)
         for i, name in enumerate(METRIC_EVENTS):
-            print(f"  {name:<{width}}  {int(events[i])}")
+            print(f"  {name:<{width}}  {int(m.events[i])}")
     if args.json:
-        print(json.dumps({
-            "sources": seen,
+        doc = {
+            "sources": m.seen,
             "latency": lat,
-            "events": {n: int(events[i])
+            "events": {n: int(m.events[i])
                        for i, n in enumerate(METRIC_EVENTS)},
-        }))
+        }
+        if m.phases:
+            doc["latency"]["phases"] = {
+                name: {**latency_summary(h), "ticks_total": int(t)}
+                for name, (h, t) in m.phases.items()
+            }
+        if m.by_key:
+            doc["latency"]["by_key"] = {
+                k: latency_summary(h) for k, h in m.by_key.items()
+            }
+        if m.by_client:
+            doc["latency"]["by_client"] = {
+                k: latency_summary(h) for k, h in m.by_client.items()
+            }
+        if m.worst is not None:
+            doc["worst_op"] = m.worst
+        print(json.dumps(doc))
 
 
 def cmd_bridge(args):
@@ -1045,6 +1222,14 @@ def main(argv=None) -> int:
     sp.add_argument("--json", action="store_true",
                     help="additionally print the merged digest as one "
                          "machine-readable JSON line")
+    sp.add_argument("--by-key", action="store_true", dest="by_key",
+                    help="render the top-N per-key latency rows (worst "
+                         "p99 first) from reports carrying the per-key "
+                         "attribution axis (kv/shardkv --metrics)")
+    sp.add_argument("--by-client", action="store_true", dest="by_client",
+                    help="render the top-N per-client latency rows")
+    sp.add_argument("--top", type=int, default=5,
+                    help="N for --by-key/--by-client (default 5)")
     sp.set_defaults(fn=cmd_stats)
 
     args = p.parse_args(argv)

@@ -375,6 +375,57 @@ def packed_bounds(cfg: "SimConfig") -> PackedBounds:
 
 HIST_BUCKETS = 16
 
+# Tail-latency attribution phases (ISSUE 12): every submit->ack latency
+# decomposes into consecutive phase durations whose sum equals the
+# end-to-end latency EXACTLY (test-pinned), each phase folding into its own
+# fixed log-spaced histogram. The taxonomy follows the optimization
+# catalogue of arXiv:1905.10786 / 2004.05074 — each production-Raft
+# optimization moves exactly one of these phases (PreVote -> leader_wait,
+# pipelined AppendEntries -> replicate, lease reads -> apply), so ROADMAP
+# item 1's knob matrix gets a per-phase readout:
+#   leader_wait  submit -> first accepted append (election windows and
+#                NotLeader retry hunts; 0 for raft-injected commands,
+#                which are born at a leader)
+#   replicate    first append -> committed (majority replication; for a
+#                clerk op, includes stale-leader false starts and
+#                re-submissions after an overwrite)
+#   apply        commit -> applied observation (Get ops waiting on the
+#                apply machine / walker; 0 for mutations)
+#   ack          applied -> ack delivery at the clerk. In the lockstep
+#                tick model the ack is same-tick, so this leg is 0 today;
+#                it is schema-present so a reply-delay model folds in
+#                without a report-format change.
+# The shardkv deployment adds:
+#   migration    pre-append ticks the clerk spent marked WrongGroup (the
+#                believed owner's leader answered but the shard was not
+#                OWNED there — a migration stall / stale-config hunt);
+#                counted out of the leader_wait window, so the sum stays
+#                exact.
+# Phase rows are keyed BY NAME in every JSON surface, so layers with
+# different phase sets merge correctly in `stats`.
+LATENCY_PHASES = ("leader_wait", "replicate", "apply", "ack")
+SHARDKV_PHASES = LATENCY_PHASES + ("migration",)
+
+# phase_names dispatches on AXIS LENGTH (the decoders — pool rows, report
+# JSON, trace tracks — see only the array), which is sound only while the
+# two taxonomies differ in length. Growing LATENCY_PHASES therefore also
+# means teaching the decoders the layer explicitly; this assert makes that
+# day a loud import error instead of silently labeling a new base phase
+# as "migration".
+assert len(LATENCY_PHASES) != len(SHARDKV_PHASES), (
+    "phase taxonomies must differ in length for phase_names dispatch; "
+    "pass the layer's phase tuple explicitly through the decoders instead"
+)
+
+
+def phase_names(n_phases: int) -> tuple:
+    """Phase-name tuple for a phase-axis length (reports/stats decode;
+    see the dispatch-contract assert above)."""
+    if n_phases == len(SHARDKV_PHASES):
+        return SHARDKV_PHASES
+    return LATENCY_PHASES[:n_phases]
+
+
 METRIC_EVENTS = (
     "elections_won",     # candidate reached majority and became leader
     "term_bumps",        # a node's term increased this tick (any cause)
@@ -390,15 +441,19 @@ METRIC_EVENTS = (
 
 
 def metrics_dims(cfg: "SimConfig") -> tuple:
-    """(hist_buckets, n_events, stamp_cap) — the metric arrays' shapes for
-    one config. ALL ZERO with metrics off: the metrics-off ClusterState
-    carries zero-size leaves (no bytes, no HBM, no packed-layout growth),
-    which is what keeps the metrics-off programs' reports — and the ci.sh
-    bytes_per_lane bound — untouched. stamp_cap sizes the per-entry
-    submit-stamp rings (log_tick / shadow_sub), which mirror log_cap."""
+    """(hist_buckets, n_events, stamp_cap, n_phases, reg) — the metric
+    arrays' shapes for one config. ALL ZERO with metrics off: the
+    metrics-off ClusterState carries zero-size leaves (no bytes, no HBM, no
+    packed-layout growth), which is what keeps the metrics-off programs'
+    reports — and the ci.sh bytes_per_lane bound — untouched. stamp_cap
+    sizes the per-entry submit-stamp rings (log_tick / shadow_sub), which
+    mirror log_cap; n_phases the per-phase histogram axis (ISSUE 12); reg
+    the worst-op register slots (scalar-like fields must be zero-SIZE when
+    off, so they are [reg] arrays, never true scalars)."""
     if not cfg.metrics:
-        return 0, 0, 0
-    return HIST_BUCKETS, len(METRIC_EVENTS), cfg.log_cap
+        return 0, 0, 0, 0, 0
+    return HIST_BUCKETS, len(METRIC_EVENTS), cfg.log_cap, \
+        len(LATENCY_PHASES), 1
 
 
 # Violation bitmask values (oracle reductions; raft oracles live in step.py,

@@ -90,7 +90,12 @@ from madraft_tpu.tpusim.engine import (
     attach_layout_telemetry,
     choose_layout_from_reason,
 )
-from madraft_tpu.tpusim.metrics import fold_latencies
+from madraft_tpu.tpusim.metrics import (
+    clerk_phase_matrix,
+    fold_latencies,
+    fold_phases,
+    update_worst,
+)
 from madraft_tpu.tpusim.state import (
     BOOL,
     ClusterState,
@@ -477,6 +482,14 @@ class CtrlerState(NamedTuple):
     #                         events-only gap). At ack, t - clerk_sub folds
     #                         into the raft lat_hist: the client-experienced
     #                         submit->ack latency, retries included
+    # --- phase boundary stamps (ISSUE 12; zero-size with metrics off —
+    # the kv.py clerk_app/clerk_cmt/clerk_apl treatment: app closes
+    # leader_wait, cmt closes replicate, apl (the walker catching up with
+    # a Query's observation) closes apply; the ctrler layer carries no
+    # per-key axis — its ops have no key — and worst-op keys report -1 ---
+    clerk_app: jax.Array
+    clerk_cmt: jax.Array
+    clerk_apl: jax.Array
     # --- per-node apply machines (live + persisted snapshot) ---
     applied: jax.Array      # i32 [N] apply cursor, absolute
     last_seq: jax.Array     # i32 [N, NC] dup table
@@ -534,6 +547,9 @@ def init_ctrler_cluster(
         clerk_q_obs=jnp.full((nc,), -1, I32),
         queries_done=jnp.zeros((nc,), I32),
         clerk_sub=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        clerk_app=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        clerk_cmt=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        clerk_apl=jnp.zeros((nc if cfg.metrics else 0,), I32),
         applied=jnp.zeros((n,), I32),
         last_seq=jnp.zeros((n, nc), I32),
         member=jnp.zeros((n, ng), jnp.bool_),
@@ -728,6 +744,19 @@ def _ctrler_service_tick(
         (s.shadow_val[None, :] == want[:, None]) & sh_live[None, :], axis=1
     )
     is_q = ks.clerk_kind == _QUERY
+    # phase boundary stamps (ISSUE 12; the kv.py treatment): cmt = first
+    # tick in the shadow, apl = first tick the Query's answer was ready
+    # (node observation recorded AND the walker caught up to it)
+    clerk_cmt, clerk_apl = ks.clerk_cmt, ks.clerk_apl
+    if cfg.metrics:
+        clerk_cmt = jnp.where(
+            ks.clerk_out & in_shadow & (clerk_cmt == 0), t, clerk_cmt
+        )
+        clerk_apl = jnp.where(
+            ks.clerk_out & (clerk_q_obs >= 0) & (w_q_seq == ks.clerk_seq)
+            & (clerk_apl == 0),
+            t, clerk_apl,
+        )
     newly_acked = ks.clerk_out & in_shadow & (
         ~is_q | ((clerk_q_obs >= 0) & (w_q_seq == ks.clerk_seq))
     )
@@ -743,10 +772,27 @@ def _ctrler_service_tick(
     # metrics (ISSUE 11 satellite): the ack is the clerk's Ok reply — fold
     # the op's whole submit->ack latency into the cluster histogram (the
     # kv.py clerk fold; ctrler ops carry log_tick 0, so the raft layer's
-    # own commit fold never double-counts them)
+    # own commit fold never double-counts them). ISSUE 12 adds the phase
+    # decomposition + worst-op register (key -1: ctrler ops have no key).
     lat_hist = s.lat_hist
+    phase_hist, phase_ticks, lat_ticks = (
+        s.phase_hist, s.phase_ticks, s.lat_ticks
+    )
+    worst = (s.worst_lat, s.worst_phases, s.worst_key, s.worst_client,
+             s.worst_sub)
     if cfg.metrics:
-        lat_hist = fold_latencies(lat_hist, t - ks.clerk_sub, newly_acked)
+        e2e = t - ks.clerk_sub
+        lat_hist = fold_latencies(lat_hist, e2e, newly_acked)
+        ph = clerk_phase_matrix(
+            t, ks.clerk_sub, ks.clerk_app, clerk_cmt, clerk_apl, is_q
+        )
+        phase_hist, phase_ticks, lat_ticks = fold_phases(
+            phase_hist, phase_ticks, lat_ticks, ph, e2e, newly_acked
+        )
+        worst = update_worst(
+            worst, e2e, newly_acked, ph,
+            jnp.full((nc,), -1, I32), cl_ids, ks.clerk_sub,
+        )
 
     # start fresh ops / retry pending ones
     kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 7)
@@ -807,11 +853,15 @@ def _ctrler_service_tick(
     clerk_arg = jnp.where(start, new_arg, ks.clerk_arg)
     clerk_q_obs = jnp.where(start, -1, clerk_q_obs)
     clerk_sub = ks.clerk_sub
+    clerk_app = ks.clerk_app
     if cfg.metrics:
         # submit stamp: the latency window opens at op start (an op never
         # acks in its start tick — the shadow ack needs a commit, which
         # takes at least one tick)
         clerk_sub = jnp.where(start, t, clerk_sub)
+        clerk_app = jnp.where(start, 0, clerk_app)
+        clerk_cmt = jnp.where(start, 0, clerk_cmt)
+        clerk_apl = jnp.where(start, 0, clerk_apl)
     clerk_out = clerk_out | start
     retry = clerk_out & (
         start | jax.random.bernoulli(kk[2], ckn.p_retry, (nc,))
@@ -826,6 +876,7 @@ def _ctrler_service_tick(
     # submit: append at the targeted node iff it believes it is the leader
     # (kv.py submit loop; stale-leader acceptance is the rejoin_2b hazard)
     log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
+    landed = []
     for c in range(nc):
         sel = me == target[c]
         ok = (
@@ -842,6 +893,12 @@ def _ctrler_service_tick(
         log_term = jnp.where(hit, s.term[:, None], log_term)
         log_val = jnp.where(hit, v, log_val)
         log_len = jnp.where(ok, log_len + 1, log_len)
+        landed.append(jnp.any(ok))
+    if cfg.metrics:
+        # leader_wait boundary (kv.py submit-loop treatment)
+        clerk_app = jnp.where(
+            jnp.stack(landed) & clerk_out & (clerk_app == 0), t, clerk_app
+        )
 
     raft = s._replace(
         log_term=log_term,
@@ -852,6 +909,14 @@ def _ctrler_service_tick(
         first_violation_tick=first_violation_tick,
         compact_floor=applied,
         lat_hist=lat_hist,
+        phase_hist=phase_hist,
+        phase_ticks=phase_ticks,
+        lat_ticks=lat_ticks,
+        worst_lat=worst[0],
+        worst_phases=worst[1],
+        worst_key=worst[2],
+        worst_client=worst[3],
+        worst_sub=worst[4],
     )
     return CtrlerState(
         raft=raft,
@@ -863,6 +928,9 @@ def _ctrler_service_tick(
         clerk_q_obs=clerk_q_obs,
         queries_done=queries_done,
         clerk_sub=clerk_sub,
+        clerk_app=clerk_app,
+        clerk_cmt=clerk_cmt,
+        clerk_apl=clerk_apl,
         applied=applied,
         last_seq=last_seq,
         member=member,
@@ -896,6 +964,9 @@ def _ctrler_service_tick(
 _CTRL_RAFT_WRITES = (
     "log_term", "log_val", "log_len", "durable_len", "violations",
     "first_violation_tick", "compact_floor", "lat_hist",
+    # attribution plane (ISSUE 12; zero-size with metrics off)
+    "phase_hist", "phase_ticks", "lat_ticks", "worst_lat", "worst_phases",
+    "worst_key", "worst_client", "worst_sub",
 )
 
 
@@ -923,6 +994,9 @@ def ctrler_packed_layout(cfg: SimConfig, kcfg: CtrlerConfig) -> tuple:
         "clerk_q_obs": I32,            # 31-bit config hash (-1 sentinel)
         "queries_done": sp.tick,
         "clerk_sub": sp.tick,
+        "clerk_app": sp.tick,          # phase boundary stamps (ISSUE 12)
+        "clerk_cmt": sp.tick,
+        "clerk_apl": sp.tick,
         "applied": sp.index,
         "last_seq": seq,
         "member": BOOL,
@@ -960,6 +1034,9 @@ class PackedCtrlerState(NamedTuple):
     clerk_q_obs: jax.Array
     queries_done: jax.Array
     clerk_sub: jax.Array
+    clerk_app: jax.Array
+    clerk_cmt: jax.Array
+    clerk_apl: jax.Array
     applied: jax.Array
     last_seq: jax.Array
     member: jax.Array
@@ -1051,6 +1128,16 @@ class CtrlerFuzzReport(NamedTuple):
     # closed); both None with cfg.metrics off
     lat_hist: Optional[np.ndarray] = None
     ev_counts: Optional[np.ndarray] = None
+    # attribution plane (ISSUE 12): phase decomposition + worst-op register
+    # (ctrler carries no per-key axis — its ops have no key)
+    phase_hist: Optional[np.ndarray] = None
+    phase_ticks: Optional[np.ndarray] = None
+    lat_ticks: Optional[np.ndarray] = None
+    worst_lat: Optional[np.ndarray] = None
+    worst_phases: Optional[np.ndarray] = None
+    worst_key: Optional[np.ndarray] = None
+    worst_client: Optional[np.ndarray] = None
+    worst_sub: Optional[np.ndarray] = None
 
     @property
     def n_violating(self) -> int:
@@ -1227,6 +1314,15 @@ def ctrler_report(final: CtrlerState) -> CtrlerFuzzReport:
         ev_counts=(
             np.asarray(final.raft.ev_counts)
             if final.raft.ev_counts.size else None
+        ),
+        **(
+            {
+                f: np.asarray(getattr(final.raft, f))
+                for f in ("phase_hist", "phase_ticks", "lat_ticks",
+                          "worst_lat", "worst_phases", "worst_key",
+                          "worst_client", "worst_sub")
+            }
+            if final.raft.lat_hist.size else {}
         ),
     )
 

@@ -26,6 +26,7 @@ from madraft_tpu.tpusim.config import (
     pool_lanes_per_shard,
     violation_names,
 )
+from madraft_tpu.tpusim.config import phase_names as _phase_names
 from madraft_tpu.tpusim.state import (
     ClusterState,
     abstract_bytes,
@@ -65,6 +66,16 @@ class FuzzReport(NamedTuple):
     # [n, HIST_BUCKETS] / [n, len(METRIC_EVENTS)] rows (rows merge by sum)
     lat_hist: Optional[np.ndarray] = None
     ev_counts: Optional[np.ndarray] = None
+    # attribution plane (ISSUE 12): per-cluster per-phase histograms/tick
+    # totals and the worst-op registers; None with cfg.metrics off
+    phase_hist: Optional[np.ndarray] = None    # [n, n_phases, HB]
+    phase_ticks: Optional[np.ndarray] = None   # [n, n_phases]
+    lat_ticks: Optional[np.ndarray] = None     # [n, 1]
+    worst_lat: Optional[np.ndarray] = None     # [n, 1]
+    worst_phases: Optional[np.ndarray] = None  # [n, n_phases]
+    worst_key: Optional[np.ndarray] = None     # [n, 1]
+    worst_client: Optional[np.ndarray] = None  # [n, 1]
+    worst_sub: Optional[np.ndarray] = None     # [n, 1]
 
     @property
     def n_violating(self) -> int:
@@ -299,6 +310,16 @@ class PoolHarvest(NamedTuple):
     # metrics-off harvest fetch is unchanged); summaries merge them by sum
     lat_hist: jax.Array
     ev_counts: jax.Array
+    # attribution plane (ISSUE 12; zero-size trailing axes when off):
+    # per-phase rows merge by sum, the worst-op registers by max
+    phase_hist: jax.Array      # [n, n_phases, HB]
+    phase_ticks: jax.Array     # [n, n_phases]
+    lat_ticks: jax.Array       # [n, 1]
+    worst_lat: jax.Array       # [n, 1]
+    worst_phases: jax.Array    # [n, n_phases]
+    worst_key: jax.Array       # [n, 1]
+    worst_client: jax.Array    # [n, 1]
+    worst_sub: jax.Array       # [n, 1]
 
 
 def _constraint(mesh: Optional[Mesh]):
@@ -333,6 +354,17 @@ def _retired_row(h, lane: int, wall: float, viol_total: int) -> dict:
         # retired cluster's tail is inspectable (and `stats` re-merges them)
         row["latency_hist"] = [int(x) for x in h.lat_hist[lane]]
         row["events"] = _metrics.event_summary(h.ev_counts[lane])
+        # attribution columns (ISSUE 12): phase rows keyed by name (the
+        # merge key `stats` uses) + the lane's worst op
+        names = _phase_names(h.phase_hist.shape[-2])
+        row["latency_phases"] = {
+            name: [int(x) for x in h.phase_hist[lane, p]]
+            for p, name in enumerate(names)
+        }
+        row["worst_op"] = _metrics.worst_op_dict(
+            h.worst_lat[lane], h.worst_phases[lane], h.worst_key[lane],
+            h.worst_client[lane], h.worst_sub[lane],
+        )
     return row
 
 
@@ -349,9 +381,16 @@ def _pool_summary(n_clusters: int, horizon: int, chunk_ticks: int,
     extra = {}
     if acct.hist_total is not None:
         # merged across lanes (and, in a sharded pool, across shards) by
-        # plain addition — the summary's client-experience digest
+        # plain addition — the summary's client-experience digest; the
+        # attribution plane (ISSUE 12) adds the phase breakdown (device-
+        # count invariant, like the e2e histogram) and the run's worst op
         extra["latency"] = _metrics.latency_summary(acct.hist_total)
+        extra["latency"]["phases"] = _metrics.phases_summary(
+            acct.phase_total, acct.phase_ticks_total
+        )
+        extra["latency"]["ticks_total"] = acct.lat_ticks_total
         extra["events"] = _metrics.event_summary(acct.ev_total)
+        extra["worst_op"] = acct.worst
     return {
         "lanes": n_clusters,
         "horizon": horizon,
@@ -408,6 +447,12 @@ class _PoolAccount:
         # summary analogue of the sharded seen-set OR-reduce
         self.hist_total: Optional[np.ndarray] = None
         self.ev_total: Optional[np.ndarray] = None
+        # attribution extras (ISSUE 12): phase rows merge by sum, the
+        # worst op by the deterministic max rule (metrics.merge_worst)
+        self.phase_total: Optional[np.ndarray] = None
+        self.phase_ticks_total: Optional[np.ndarray] = None
+        self.lat_ticks_total = 0
+        self.worst: Optional[dict] = None
 
     def consume(self, h, wall: float, children_ran: bool) -> None:
         """Account one fetched harvest. ``children_ran`` is True iff a
@@ -423,9 +468,21 @@ class _PoolAccount:
         if h.lat_hist.shape[-1] and self.hist_total is None:
             self.hist_total = np.zeros(h.lat_hist.shape[-1], np.int64)
             self.ev_total = np.zeros(h.ev_counts.shape[-1], np.int64)
+            self.phase_total = np.zeros(h.phase_hist.shape[-2:], np.int64)
+            self.phase_ticks_total = np.zeros(h.phase_ticks.shape[-1],
+                                              np.int64)
         if self.hist_total is not None and h.retired.any():
             self.hist_total += h.lat_hist[h.retired].sum(axis=0)
             self.ev_total += h.ev_counts[h.retired].sum(axis=0)
+            self.phase_total += h.phase_hist[h.retired].sum(axis=0)
+            self.phase_ticks_total += h.phase_ticks[h.retired].sum(axis=0)
+            self.lat_ticks_total += int(h.lat_ticks[h.retired].sum())
+            self.worst = _metrics.merge_worst_registers(
+                h.worst_lat[h.retired], h.worst_phases[h.retired],
+                h.worst_key[h.retired], h.worst_client[h.retired],
+                h.worst_sub[h.retired], ids=h.ids[h.retired],
+                into=self.worst,
+            )
         for lane in np.nonzero(h.retired)[0]:
             mask = int(h.violations[lane])
             fvt = int(h.first_violation_tick[lane])
@@ -470,6 +527,15 @@ class _PoolAccount:
             # merged at their harvest, so nothing double-counts
             self.hist_total += h.lat_hist[~h.retired].sum(axis=0)
             self.ev_total += h.ev_counts[~h.retired].sum(axis=0)
+            self.phase_total += h.phase_hist[~h.retired].sum(axis=0)
+            self.phase_ticks_total += h.phase_ticks[~h.retired].sum(axis=0)
+            self.lat_ticks_total += int(h.lat_ticks[~h.retired].sum())
+            self.worst = _metrics.merge_worst_registers(
+                h.worst_lat[~h.retired], h.worst_phases[~h.retired],
+                h.worst_key[~h.retired], h.worst_client[~h.retired],
+                h.worst_sub[~h.retired], ids=h.ids[~h.retired],
+                into=self.worst,
+            )
 
 
 def _pipeline(launch_chunk, launch_harvest, acct: _PoolAccount,
@@ -784,6 +850,14 @@ def _pool_snapshot(states, retired, ids) -> PoolHarvest:
         ticks_run=states.tick,
         lat_hist=states.lat_hist,
         ev_counts=states.ev_counts,
+        phase_hist=states.phase_hist,
+        phase_ticks=states.phase_ticks,
+        lat_ticks=states.lat_ticks,
+        worst_lat=states.worst_lat,
+        worst_phases=states.worst_phases,
+        worst_key=states.worst_key,
+        worst_client=states.worst_client,
+        worst_sub=states.worst_sub,
     )
 
 
@@ -1142,6 +1216,14 @@ class CovHarvest(NamedTuple):
     ticks_run: jax.Array
     lat_hist: jax.Array     # metrics rows (PoolHarvest; zero-size when off)
     ev_counts: jax.Array
+    phase_hist: jax.Array   # attribution rows (PoolHarvest; ISSUE 12)
+    phase_ticks: jax.Array
+    lat_ticks: jax.Array
+    worst_lat: jax.Array
+    worst_phases: jax.Array
+    worst_key: jax.Array
+    worst_client: jax.Array
+    worst_sub: jax.Array
     new_fps: jax.Array      # i32 [n]: new fingerprints this lane discovered
     #                         since ITS refill (its whole lifetime)
     refill_kind: jax.Array  # i32 [n]: how this lane's knobs were produced
@@ -1662,6 +1744,10 @@ def make_sweep_fn(
 
 def report(final: ClusterState) -> FuzzReport:
     has_metrics = final.lat_hist.size > 0
+
+    def m(x):
+        return np.asarray(x) if has_metrics else None
+
     return FuzzReport(
         violations=np.asarray(final.violations),
         first_violation_tick=np.asarray(final.first_violation_tick),
@@ -1669,8 +1755,16 @@ def report(final: ClusterState) -> FuzzReport:
         committed=np.asarray(final.shadow_len),
         msg_count=np.asarray(final.msg_count),
         snap_installs=np.asarray(final.snap_install_count),
-        lat_hist=np.asarray(final.lat_hist) if has_metrics else None,
-        ev_counts=np.asarray(final.ev_counts) if has_metrics else None,
+        lat_hist=m(final.lat_hist),
+        ev_counts=m(final.ev_counts),
+        phase_hist=m(final.phase_hist),
+        phase_ticks=m(final.phase_ticks),
+        lat_ticks=m(final.lat_ticks),
+        worst_lat=m(final.worst_lat),
+        worst_phases=m(final.worst_phases),
+        worst_key=m(final.worst_key),
+        worst_client=m(final.worst_client),
+        worst_sub=m(final.worst_sub),
     )
 
 

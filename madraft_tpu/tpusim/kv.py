@@ -49,6 +49,7 @@ from madraft_tpu.tpusim.config import (
     LEADER,
     NOOP_CMD,
     SimConfig,
+    metrics_dims,
     packed_bounds,
 )
 from madraft_tpu.tpusim.engine import (
@@ -56,7 +57,13 @@ from madraft_tpu.tpusim.engine import (
     attach_layout_telemetry,
     choose_layout_from_reason,
 )
-from madraft_tpu.tpusim.metrics import fold_latencies
+from madraft_tpu.tpusim.metrics import (
+    clerk_phase_matrix,
+    fold_latencies,
+    fold_latencies_by,
+    fold_phases,
+    update_worst,
+)
 from madraft_tpu.tpusim.state import (
     BOOL,
     ClusterState,
@@ -224,6 +231,24 @@ class KvState(NamedTuple):
     #                          into the raft state's lat_hist — the client-
     #                          experienced submit->ack latency, retries and
     #                          leader-hunting included
+    # --- phase boundary stamps (ISSUE 12; zero-size with metrics off).
+    # sub <= app <= cmt <= apl-or-cmt <= ack tick by construction, so the
+    # consecutive differences are the exact phase decomposition
+    # (config.LATENCY_PHASES) and telescope to the e2e latency. ---
+    clerk_app: jax.Array     # i32 [NC] first tick a submit LANDED (appended
+    #                          at a self-believed leader; 0 = not yet) —
+    #                          closes the leader_wait phase
+    clerk_cmt: jax.Array     # i32 [NC] first tick the op showed in the
+    #                          committed shadow — closes replicate
+    clerk_apl: jax.Array     # i32 [NC] first tick a Get's observation was
+    #                          recorded by an apply machine — closes apply
+    client_retries: jax.Array  # i32 [NC] submit attempts (the per-client
+    #                            event row: NotLeader hunts show up here)
+    # --- attribution axes (ISSUE 12; zero-size with metrics off): e2e
+    # latency histograms per key and per client, merged by plain addition
+    # like every other hist row ---
+    key_lat_hist: jax.Array     # i32 [NK, HIST_BUCKETS]
+    client_lat_hist: jax.Array  # i32 [NC, HIST_BUCKETS]
     # --- reads-linearizability oracle state ---
     # The log totally orders mutations (Appends and Puts), so key k's
     # observable state IS its committed MUTATION VERSION — the count of
@@ -299,6 +324,14 @@ def init_kv_cluster(
         clerk_leader=jnp.full((nc,), -1, I32),
         clerk_wait=jnp.zeros((nc,), I32),
         clerk_sub=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        clerk_app=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        clerk_cmt=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        clerk_apl=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        client_retries=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        key_lat_hist=jnp.zeros((nk if cfg.metrics else 0,
+                                metrics_dims(cfg)[0]), I32),
+        client_lat_hist=jnp.zeros((nc if cfg.metrics else 0,
+                                   metrics_dims(cfg)[0]), I32),
         truth_count=jnp.zeros((nk,), I32),
         truth_max_seq=jnp.zeros((nc,), I32),
         clerk_get_lo=jnp.zeros((nc,), I32),
@@ -531,6 +564,18 @@ def _kv_service_tick(
         (s.shadow_val[None, :] == want[:, None]) & sh_live[None, :], axis=1
     )
     is_get = ks.clerk_kind == _GET
+    # phase boundary stamps (ISSUE 12): commit = first tick the op shows in
+    # the shadow, apply = first tick its Get observation landed — recorded
+    # while outstanding, reset at the next start
+    clerk_cmt, clerk_apl = ks.clerk_cmt, ks.clerk_apl
+    if cfg.metrics:
+        clerk_cmt = jnp.where(
+            ks.clerk_out & in_shadow & (clerk_cmt == 0), t, clerk_cmt
+        )
+        clerk_apl = jnp.where(
+            ks.clerk_out & (clerk_get_obs >= 0) & (clerk_apl == 0), t,
+            clerk_apl,
+        )
     newly_acked = ks.clerk_out & in_shadow & (~is_get | (clerk_get_obs >= 0))
     # Reads linearizability: the observed count must lie in the op's
     # [invoke, return] truth window (exact for append-count registers; see
@@ -550,10 +595,34 @@ def _kv_service_tick(
     # metrics (ISSUE 10): the ack is the clerk's Ok reply — fold the op's
     # whole submit->ack latency (stamped at op START, so retries and
     # NotLeader hunting are inside the measured window, exactly what a
-    # client experiences) into the cluster's latency histogram
+    # client experiences) into the cluster's latency histogram; the
+    # attribution plane (ISSUE 12) additionally folds the phase
+    # decomposition, the per-key/per-client axes, and the worst-op register
     lat_hist = s.lat_hist
+    phase_hist, phase_ticks, lat_ticks = (
+        s.phase_hist, s.phase_ticks, s.lat_ticks
+    )
+    worst = (s.worst_lat, s.worst_phases, s.worst_key, s.worst_client,
+             s.worst_sub)
+    key_lat_hist, client_lat_hist = ks.key_lat_hist, ks.client_lat_hist
     if cfg.metrics:
-        lat_hist = fold_latencies(lat_hist, t - ks.clerk_sub, newly_acked)
+        e2e = t - ks.clerk_sub
+        lat_hist = fold_latencies(lat_hist, e2e, newly_acked)
+        ph = clerk_phase_matrix(
+            t, ks.clerk_sub, ks.clerk_app, clerk_cmt, clerk_apl, is_get
+        )
+        phase_hist, phase_ticks, lat_ticks = fold_phases(
+            phase_hist, phase_ticks, lat_ticks, ph, e2e, newly_acked
+        )
+        worst = update_worst(
+            worst, e2e, newly_acked, ph, ks.clerk_key, cl_ids, ks.clerk_sub
+        )
+        key_lat_hist = fold_latencies_by(
+            key_lat_hist, e2e, newly_acked, ks.clerk_key
+        )
+        client_lat_hist = fold_latencies_by(
+            client_lat_hist, e2e, newly_acked, cl_ids
+        )
 
     # start fresh ops / retry pending ones
     kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 4)
@@ -586,11 +655,16 @@ def _kv_service_tick(
     clerk_get_lo = jnp.where(start, truth_at_new, ks.clerk_get_lo)
     clerk_get_obs = jnp.where(start, -1, clerk_get_obs)
     clerk_sub = ks.clerk_sub
+    clerk_app = ks.clerk_app
     if cfg.metrics:
         # submit stamp: a fresh op's latency window opens NOW (an op never
         # acks in its start tick — the serve path below requires ~start and
-        # the shadow ack needs a commit, which takes at least one tick)
+        # the shadow ack needs a commit, which takes at least one tick);
+        # the phase boundary stamps reset with it
         clerk_sub = jnp.where(start, t, clerk_sub)
+        clerk_app = jnp.where(start, 0, clerk_app)
+        clerk_cmt = jnp.where(start, 0, clerk_cmt)
+        clerk_apl = jnp.where(start, 0, clerk_apl)
     clerk_out = clerk_out | start
     retry = clerk_out & (
         start
@@ -599,6 +673,12 @@ def _kv_service_tick(
             & (ks.clerk_wait <= 0)
         )
     )
+    client_retries = ks.client_retries
+    if cfg.metrics:
+        # per-client submit-attempt counter (the event row of the
+        # per-client axis): every attempt counts, whether it lands, is
+        # bug-served, or bounces off a non-leader
+        client_retries = client_retries + retry.astype(I32)
     target = jax.random.randint(kk[3], (nc,), 0, n, dtype=I32)
     # NotLeader{hint} routing (msg.rs:10-18): with p_follow_hint, a clerk
     # holding a leader belief targets it instead of the random draw.
@@ -651,8 +731,24 @@ def _kv_service_tick(
     clerk_last_obs = jnp.where(served, local_cnt, clerk_last_obs)
     if cfg.metrics:
         # the bug-mode local serve is an ack too (served ops are ~start, so
-        # their stamp is untouched by this tick's start update above)
-        lat_hist = fold_latencies(lat_hist, t - clerk_sub, served)
+        # their stamp is untouched by this tick's start update above). A
+        # local serve skips the log entirely, so its whole latency is
+        # attributed to the apply phase (state was read from an apply
+        # machine) — any consecutive split keeps the phase sum exact.
+        e2e_s = t - clerk_sub
+        lat_hist = fold_latencies(lat_hist, e2e_s, served)
+        zeros = jnp.zeros_like(e2e_s)
+        ph_s = jnp.stack([zeros, zeros, e2e_s, zeros])
+        phase_hist, phase_ticks, lat_ticks = fold_phases(
+            phase_hist, phase_ticks, lat_ticks, ph_s, e2e_s, served
+        )
+        worst = update_worst(
+            worst, e2e_s, served, ph_s, clerk_key, cl_ids, clerk_sub
+        )
+        key_lat_hist = fold_latencies_by(key_lat_hist, e2e_s, served,
+                                         clerk_key)
+        client_lat_hist = fold_latencies_by(client_lat_hist, e2e_s, served,
+                                            cl_ids)
 
     violations = s.violations | viol
     first_violation_tick = jnp.where(
@@ -665,6 +761,7 @@ def _kv_service_tick(
     # the committed-read path (the reference commits Get ops for exactly this
     # linearizability, kvraft/server.rs Op::Get).
     log_term, log_val, log_len = s.log_term, s.log_val, s.log_len
+    landed = []
     for c in range(nc):
         sel = me == target[c]                         # one-hot over nodes
         ok = (
@@ -681,6 +778,15 @@ def _kv_service_tick(
         log_term = jnp.where(hit, s.term[:, None], log_term)
         log_val = jnp.where(hit, v, log_val)
         log_len = jnp.where(ok, log_len + 1, log_len)
+        landed.append(jnp.any(ok))
+    if cfg.metrics:
+        # the leader_wait boundary: the FIRST tick this op's submit was
+        # accepted by a self-believed leader (a stale leader counts — the
+        # hunt is over even if replication then restarts; the extra wait
+        # lands in the replicate phase, where the re-replication happened)
+        clerk_app = jnp.where(
+            jnp.stack(landed) & clerk_out & (clerk_app == 0), t, clerk_app
+        )
 
     # The submit's "reply" teaches the clerk where the leader is (ClerkCore
     # leader_ cache, client.rs:32-63): reaching the leader pins the belief;
@@ -738,6 +844,14 @@ def _kv_service_tick(
         # _check_kv_cfg pins p_client_cmd=0, so the raft layer's own
         # commit fold never double-counts a clerk op)
         lat_hist=lat_hist,
+        phase_hist=phase_hist,
+        phase_ticks=phase_ticks,
+        lat_ticks=lat_ticks,
+        worst_lat=worst[0],
+        worst_phases=worst[1],
+        worst_key=worst[2],
+        worst_client=worst[3],
+        worst_sub=worst[4],
     )
     return KvState(
         raft=raft,
@@ -749,6 +863,12 @@ def _kv_service_tick(
         clerk_leader=clerk_leader,
         clerk_wait=clerk_wait,
         clerk_sub=clerk_sub,
+        clerk_app=clerk_app,
+        clerk_cmt=clerk_cmt,
+        clerk_apl=clerk_apl,
+        client_retries=client_retries,
+        key_lat_hist=key_lat_hist,
+        client_lat_hist=client_lat_hist,
         truth_count=truth_count,
         truth_max_seq=truth_max_seq,
         clerk_get_lo=clerk_get_lo,
@@ -786,6 +906,10 @@ def _kv_service_tick(
 _KV_RAFT_WRITES = (
     "log_term", "log_val", "log_len", "durable_len", "violations",
     "first_violation_tick", "compact_floor", "lat_hist",
+    # attribution plane (ISSUE 12): the clerk folds write these raft-level
+    # rows too; zero-size with metrics off, so the fused re-pack is free
+    "phase_hist", "phase_ticks", "lat_ticks", "worst_lat", "worst_phases",
+    "worst_key", "worst_client", "worst_sub",
 )
 
 
@@ -822,6 +946,12 @@ def kv_packed_layout(cfg: SimConfig, kcfg: KvConfig) -> tuple:
         "clerk_leader": jnp.int8,      # node id, -1 sentinel (n_nodes <= 16)
         "clerk_wait": sp.tick,         # retry_wait gated <= b.tick
         "clerk_sub": sp.tick,
+        "clerk_app": sp.tick,          # phase boundary stamps (ISSUE 12)
+        "clerk_cmt": sp.tick,
+        "clerk_apl": sp.tick,
+        "client_retries": sp.tick,     # at most one attempt per tick
+        "key_lat_hist": sp.index,      # bucket counts <= acked ops
+        "client_lat_hist": sp.index,
         "truth_count": sp.index,
         "truth_max_seq": seq,
         "clerk_get_lo": sp.index,
@@ -856,6 +986,12 @@ class PackedKvState(NamedTuple):
     clerk_leader: jax.Array
     clerk_wait: jax.Array
     clerk_sub: jax.Array
+    clerk_app: jax.Array
+    clerk_cmt: jax.Array
+    clerk_apl: jax.Array
+    client_retries: jax.Array
+    key_lat_hist: jax.Array
+    client_lat_hist: jax.Array
     truth_count: jax.Array
     truth_max_seq: jax.Array
     clerk_get_lo: jax.Array
@@ -953,6 +1089,20 @@ class KvFuzzReport(NamedTuple):
     # counters per cluster; None with cfg.metrics off
     lat_hist: Optional[np.ndarray] = None
     ev_counts: Optional[np.ndarray] = None
+    # attribution plane (ISSUE 12): per-phase histograms/tick totals, the
+    # per-key/per-client axes, and the per-cluster worst-op registers;
+    # None with cfg.metrics off
+    phase_hist: Optional[np.ndarray] = None     # [C, n_phases, HB]
+    phase_ticks: Optional[np.ndarray] = None    # [C, n_phases]
+    lat_ticks: Optional[np.ndarray] = None      # [C, 1]
+    key_hist: Optional[np.ndarray] = None       # [C, NK, HB]
+    client_hist: Optional[np.ndarray] = None    # [C, NC, HB]
+    client_retries: Optional[np.ndarray] = None  # [C, NC]
+    worst_lat: Optional[np.ndarray] = None      # [C, 1]
+    worst_phases: Optional[np.ndarray] = None   # [C, n_phases]
+    worst_key: Optional[np.ndarray] = None      # [C, 1]
+    worst_client: Optional[np.ndarray] = None   # [C, 1]
+    worst_sub: Optional[np.ndarray] = None      # [C, 1]
 
     @property
     def n_violating(self) -> int:
@@ -1126,6 +1276,10 @@ def make_kv_sweep_fn(
 
 def kv_report(final: KvState) -> KvFuzzReport:
     has_metrics = final.raft.lat_hist.size > 0
+
+    def m(x):
+        return np.asarray(x) if has_metrics else None
+
     return KvFuzzReport(
         violations=np.asarray(final.raft.violations),
         first_violation_tick=np.asarray(final.raft.first_violation_tick),
@@ -1134,8 +1288,19 @@ def kv_report(final: KvState) -> KvFuzzReport:
         committed=np.asarray(final.raft.shadow_len),
         msg_count=np.asarray(final.raft.msg_count),
         snap_installs=np.asarray(final.raft.snap_install_count),
-        lat_hist=np.asarray(final.raft.lat_hist) if has_metrics else None,
-        ev_counts=np.asarray(final.raft.ev_counts) if has_metrics else None,
+        lat_hist=m(final.raft.lat_hist),
+        ev_counts=m(final.raft.ev_counts),
+        phase_hist=m(final.raft.phase_hist),
+        phase_ticks=m(final.raft.phase_ticks),
+        lat_ticks=m(final.raft.lat_ticks),
+        key_hist=m(final.key_lat_hist),
+        client_hist=m(final.client_lat_hist),
+        client_retries=m(final.client_retries),
+        worst_lat=m(final.raft.worst_lat),
+        worst_phases=m(final.raft.worst_phases),
+        worst_key=m(final.raft.worst_key),
+        worst_client=m(final.raft.worst_client),
+        worst_sub=m(final.raft.worst_sub),
     )
 
 

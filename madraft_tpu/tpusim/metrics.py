@@ -23,10 +23,15 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from madraft_tpu.tpusim.config import HIST_BUCKETS, METRIC_EVENTS
+from madraft_tpu.tpusim.config import (
+    HIST_BUCKETS,
+    METRIC_EVENTS,
+    phase_names,
+)
 
 I32 = jnp.int32
 
@@ -53,6 +58,157 @@ def fold_latencies(hist: jnp.ndarray, lat: jnp.ndarray,
         jnp.arange(HIST_BUCKETS, dtype=I32)[None, :] == bucket[:, None]
     ) & flat_mask[:, None]
     return hist + jnp.sum(oh, axis=0, dtype=I32)
+
+
+def fold_latencies_by(hist2d: jnp.ndarray, lat: jnp.ndarray,
+                      mask: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Row-attributed fold (ISSUE 12): add each masked latency's bucket to
+    ROW ``idx[i]`` of ``hist2d`` ([rows, HIST_BUCKETS]) — the per-key /
+    per-client attribution axes. Same one-hot-sum idiom as fold_latencies
+    (no scatters), with a second one-hot over the row axis."""
+    rows = hist2d.shape[0]
+    edges = jnp.asarray(BUCKET_EDGES, I32)
+    bucket = jnp.sum((lat[:, None] >= edges[None, :]).astype(I32), axis=1)
+    b_oh = jnp.arange(HIST_BUCKETS, dtype=I32)[None, :] == bucket[:, None]
+    r_oh = jnp.arange(rows, dtype=I32)[None, :] == idx[:, None]  # [m, rows]
+    hit = (r_oh[:, :, None] & b_oh[:, None, :]) & mask[:, None, None]
+    return hist2d + jnp.sum(hit, axis=0, dtype=I32)
+
+
+def fold_phases(phase_hist: jnp.ndarray, phase_ticks: jnp.ndarray,
+                lat_ticks: jnp.ndarray, phases: jnp.ndarray,
+                lat: jnp.ndarray, mask: jnp.ndarray) -> tuple:
+    """The phase-decomposition fold (ISSUE 12): for every masked acked op,
+    fold EACH phase duration into that phase's histogram row (zeros land in
+    bucket 0, so every phase row's mass equals the acked-op count — the
+    same hist-sum==acked invariant shape as the e2e histogram) and
+    accumulate the exact tick totals. ``phases`` is [n_phases, m]; the
+    per-op invariant sum(phases[:, i]) == lat[i] is the caller's contract
+    (each call site derives phases as consecutive stamp differences, so it
+    holds by construction) and makes sum(phase_ticks) == lat_ticks exact —
+    test-pinned end to end."""
+    new_hist = jax.vmap(lambda h, p: fold_latencies(h, p, mask))(
+        phase_hist, phases
+    )
+    new_ticks = phase_ticks + jnp.sum(
+        jnp.where(mask[None, :], phases, 0), axis=1, dtype=I32
+    )
+    new_lat = lat_ticks + jnp.sum(jnp.where(mask, lat, 0), dtype=I32)
+    return new_hist, new_ticks, new_lat
+
+
+def clerk_phase_matrix(t, sub, app, cmt, apl, is_get):
+    """Exact 4-phase decomposition [n_phases, NC] of the e2e latency
+    ``t - sub`` from the clerk boundary stamps (config.LATENCY_PHASES
+    order). The boundaries are clamped monotone (sub <= app <= cmt <= b3
+    <= t), so the rows always telescope to exactly t - sub — the pinned
+    phase-sum invariant holds per op by construction, not by bookkeeping.
+    Shared by the kv and ctrler clerks; shardkv extends it with the
+    migration row."""
+    app_e = jnp.maximum(app, sub)
+    cmt_e = jnp.maximum(cmt, app_e)
+    b3 = jnp.where(is_get, jnp.maximum(apl, cmt_e), cmt_e)
+    return jnp.stack([app_e - sub, cmt_e - app_e, b3 - cmt_e, t - b3])
+
+
+def update_worst(reg: tuple, lat: jnp.ndarray, mask: jnp.ndarray,
+                 phases: jnp.ndarray, keys: jnp.ndarray,
+                 clients: jnp.ndarray, subs: jnp.ndarray) -> tuple:
+    """Per-lane worst-op register update (ISSUE 12): among this tick's
+    masked acks, the argmax-latency op replaces the register when it beats
+    the held worst (or the register is empty — worst_sub 0 means no op
+    captured yet; real submit stamps are >= 1). ``reg`` is the 5-tuple
+    (worst_lat [1], worst_phases [n_phases], worst_key [1],
+    worst_client [1], worst_sub [1]); deterministic tie-breaking: ties
+    keep the held op (strict >), and within a tick argmax picks the
+    lowest index."""
+    worst_lat, worst_phases, worst_key, worst_client, worst_sub = reg
+    i = jnp.argmax(jnp.where(mask, lat, -1))
+    oh = jnp.arange(lat.shape[0], dtype=I32) == i
+
+    def sel(x):
+        return jnp.sum(jnp.where(oh, x, 0), axis=-1, dtype=I32)
+
+    cand = sel(lat)
+    better = jnp.any(mask) & ((cand > worst_lat[0]) | (worst_sub[0] == 0))
+    return (
+        jnp.where(better, cand, worst_lat[0])[None],
+        jnp.where(better, sel(phases), worst_phases),
+        jnp.where(better, sel(keys), worst_key[0])[None],
+        jnp.where(better, sel(clients), worst_client[0])[None],
+        jnp.where(better, sel(subs), worst_sub[0])[None],
+    )
+
+
+def phases_summary(phase_hist, phase_ticks,
+                   ms_per_tick: Optional[int] = None) -> dict:
+    """The ``latency.phases`` dict every report surface carries: one
+    latency_summary per phase row, keyed BY NAME (layers with different
+    phase sets merge by name downstream), plus the exact tick total — the
+    attribution readout (which phase the tail lives in)."""
+    names = phase_names(len(phase_hist))
+    pt = np.asarray(phase_ticks, np.int64)
+    out = {}
+    for p, name in enumerate(names):
+        d = latency_summary(phase_hist[p], ms_per_tick)
+        d["ticks_total"] = int(pt[p])
+        out[name] = d
+    return out
+
+
+def worst_op_dict(worst_lat, worst_phases, worst_key, worst_client,
+                  worst_sub) -> Optional[dict]:
+    """Decode one worst-op register into the report dict (None when the
+    register is empty — no op ever acked on this lane)."""
+    if int(np.asarray(worst_sub).reshape(-1)[0]) == 0:
+        return None
+    names = phase_names(np.asarray(worst_phases).reshape(-1).shape[0])
+    ph = np.asarray(worst_phases, np.int64).reshape(-1)
+    return {
+        "latency_ticks": int(np.asarray(worst_lat).reshape(-1)[0]),
+        "submit_tick": int(np.asarray(worst_sub).reshape(-1)[0]),
+        "key": int(np.asarray(worst_key).reshape(-1)[0]),
+        "client": int(np.asarray(worst_client).reshape(-1)[0]),
+        "phases": {name: int(ph[p]) for p, name in enumerate(names)},
+    }
+
+
+def merge_worst(a: Optional[dict], b: Optional[dict],
+                a_id=None, b_id=None) -> Optional[dict]:
+    """Deterministic merge of two worst-op dicts (each may carry a
+    ``cluster_id``): higher latency wins; ties break toward the smaller
+    cluster id, so the pool-summary worst op is device-count invariant
+    (the retired-row multiset is)."""
+    if a is not None and a_id is not None and "cluster_id" not in a:
+        a = {**a, "cluster_id": int(a_id)}
+    if b is not None and b_id is not None and "cluster_id" not in b:
+        b = {**b, "cluster_id": int(b_id)}
+    if a is None:
+        return b
+    if b is None:
+        return a
+    ka = (a["latency_ticks"], -a.get("cluster_id", 0))
+    kb = (b["latency_ticks"], -b.get("cluster_id", 0))
+    return a if ka >= kb else b
+
+
+def merge_worst_registers(worst_lat, worst_phases, worst_key,
+                          worst_client, worst_sub, ids=None,
+                          into: Optional[dict] = None) -> Optional[dict]:
+    """Merge a batch of per-lane worst-op registers (leading axis = lanes)
+    into one dict under the merge_worst rule — THE one copy of the
+    register-decode loop shared by the report JSON, the pool accounting,
+    and bench's tail_attrib row. ``ids`` labels each lane's cluster id
+    (defaults to the lane index); ``into`` seeds the merge."""
+    worst = into
+    for c in range(np.asarray(worst_lat).shape[0]):
+        worst = merge_worst(
+            worst,
+            worst_op_dict(worst_lat[c], worst_phases[c], worst_key[c],
+                          worst_client[c], worst_sub[c]),
+            b_id=int(ids[c]) if ids is not None else c,
+        )
+    return worst
 
 
 def host_bucket(lat: np.ndarray) -> np.ndarray:

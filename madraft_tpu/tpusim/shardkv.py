@@ -79,6 +79,7 @@ from madraft_tpu.tpusim.config import (
     NOOP_CMD,
     SimConfig,
     metrics_dims,
+    SHARDKV_PHASES,
     packed_bounds,
 )
 from madraft_tpu.tpusim.ctrler import _rebalance as _ctrl_rebalance
@@ -87,7 +88,12 @@ from madraft_tpu.tpusim.engine import (
     attach_layout_telemetry,
     choose_layout_from_reason,
 )
-from madraft_tpu.tpusim.metrics import fold_latencies
+from madraft_tpu.tpusim.metrics import (
+    fold_latencies,
+    fold_latencies_by,
+    fold_phases,
+    update_worst,
+)
 from madraft_tpu.tpusim.state import (
     BOOL,
     ClusterState,
@@ -510,6 +516,28 @@ class ShardKvState(NamedTuple):
     #                               group's raft row; migration stalls and
     #                               WrongGroup re-query hunts are inside the
     #                               measured window
+    # --- attribution plane (ISSUE 12; zero-size with metrics off).
+    # Boundary stamps follow kv.py (app = first landed append ANYWHERE —
+    # a wrong-group append counts, its rejection wait lands in replicate;
+    # cmt = walker accept; apl = Get observation), plus the shardkv-only
+    # migration counter: clerk_mig counts pre-append ticks the clerk spent
+    # marked WrongGroup, and is carved OUT of leader_wait so the 5-phase
+    # sum (config.SHARDKV_PHASES) still telescopes to t - sub exactly. ---
+    clerk_app: jax.Array          # i32 [NC]
+    clerk_cmt: jax.Array          # i32 [NC]
+    clerk_apl: jax.Array          # i32 [NC]
+    clerk_mig: jax.Array          # i32 [NC] WrongGroup wait ticks
+    client_retries: jax.Array     # i32 [NC] submit attempts
+    phase_hist: jax.Array         # i32 [5, HIST_BUCKETS] (SHARDKV_PHASES)
+    phase_ticks: jax.Array        # i32 [5]
+    lat_ticks: jax.Array          # i32 [1]
+    worst_lat: jax.Array          # i32 [1]
+    worst_phases: jax.Array       # i32 [5]
+    worst_key: jax.Array          # i32 [1] — the op's SHARD
+    worst_client: jax.Array       # i32 [1]
+    worst_sub: jax.Array          # i32 [1]
+    key_lat_hist: jax.Array       # i32 [NS, HIST_BUCKETS] per-shard axis
+    client_lat_hist: jax.Array    # i32 [NC, HIST_BUCKETS]
     # --- truth walker (oracle ground truth at each group's shadow frontier) ---
     w_frontier: jax.Array        # i32 [G] entries walked (absolute shadow index)
     w_cfg: jax.Array             # i32 [G]
@@ -613,6 +641,24 @@ def _gen_schedule(cfg: SimConfig, kcfg: ShardKvConfig, key: jax.Array, skn):
     )
     cfg_owner = jnp.concatenate([owner0[None], owners], axis=0)
     return cfg_tick, cfg_owner
+
+
+def _shardkv_phase_matrix(t, sub, app, cmt, apl, mig, is_get):
+    """Exact 5-phase decomposition [len(SHARDKV_PHASES), NC] of t - sub
+    (kv.clerk_phase_matrix plus the migration row): boundaries are clamped
+    monotone and the migration wait is clipped into the pre-append window,
+    so the rows always telescope to exactly t - sub."""
+    app_e = jnp.maximum(app, sub)
+    mig_e = jnp.minimum(mig, app_e - sub)
+    cmt_e = jnp.maximum(cmt, app_e)
+    b3 = jnp.where(is_get, jnp.maximum(apl, cmt_e), cmt_e)
+    return jnp.stack([
+        app_e - sub - mig_e,   # leader_wait
+        cmt_e - app_e,         # replicate (incl. wrong-group rejections)
+        b3 - cmt_e,            # apply
+        t - b3,                # ack
+        mig_e,                 # migration
+    ])
 
 
 def _check_shardkv_cfg(cfg: SimConfig) -> None:
@@ -733,6 +779,32 @@ def init_shardkv_cluster(
         gets_done=jnp.zeros((nc,), I32),
         clerk_sub=jnp.zeros((nc if cfg.metrics else 0,), I32),
         lat_hist=jnp.zeros(metrics_dims(cfg)[:1], I32),
+        clerk_app=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        clerk_cmt=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        clerk_apl=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        clerk_mig=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        client_retries=jnp.zeros((nc if cfg.metrics else 0,), I32),
+        phase_hist=jnp.zeros(
+            (len(SHARDKV_PHASES) if cfg.metrics else 0,
+             metrics_dims(cfg)[0]), I32,
+        ),
+        phase_ticks=jnp.zeros(
+            (len(SHARDKV_PHASES) if cfg.metrics else 0,), I32
+        ),
+        lat_ticks=jnp.zeros(metrics_dims(cfg)[4:], I32),
+        worst_lat=jnp.zeros(metrics_dims(cfg)[4:], I32),
+        worst_phases=jnp.zeros(
+            (len(SHARDKV_PHASES) if cfg.metrics else 0,), I32
+        ),
+        worst_key=jnp.full(metrics_dims(cfg)[4:], -1, I32),
+        worst_client=jnp.full(metrics_dims(cfg)[4:], -1, I32),
+        worst_sub=jnp.zeros(metrics_dims(cfg)[4:], I32),
+        key_lat_hist=jnp.zeros(
+            (ns if cfg.metrics else 0, metrics_dims(cfg)[0]), I32
+        ),
+        client_lat_hist=jnp.zeros(
+            (nc if cfg.metrics else 0, metrics_dims(cfg)[0]), I32
+        ),
         w_frontier=jnp.zeros((g,), I32),
         w_cfg=jnp.zeros((g,), I32),
         w_phase=phase0[:, 0, :],
@@ -1661,6 +1733,19 @@ def _shardkv_service_tick(
     sh_oh_c = sh_lane[None, :] == st.clerk_shard[:, None]  # [NC, NS]
     truth_at = jnp.sum(jnp.where(sh_oh_c, truth_count[None, :], 0), axis=1)
     is_get_c = st.clerk_kind == _GET
+    # phase boundary stamps (ISSUE 12): cmt = first tick the walker
+    # accepted the op, apl = first tick its Get observation landed
+    clerk_cmt, clerk_apl = st.clerk_cmt, st.clerk_apl
+    if cfg.metrics:
+        clerk_cmt = jnp.where(
+            st.clerk_out & (w_clerk_acked >= st.clerk_seq)
+            & (clerk_cmt == 0),
+            t, clerk_cmt,
+        )
+        clerk_apl = jnp.where(
+            st.clerk_out & (clerk_get_obs >= 0) & (clerk_apl == 0), t,
+            clerk_apl,
+        )
     newly = (
         st.clerk_out & (w_clerk_acked >= st.clerk_seq)
         & (~is_get_c | (clerk_get_obs >= 0))
@@ -1684,8 +1769,30 @@ def _shardkv_service_tick(
     # stamped at op start, so config hunts, WrongGroup retries, and
     # migration stalls are all inside the measured window (kv.py fold)
     lat_hist = st.lat_hist
+    phase_hist, phase_ticks, lat_ticks = (
+        st.phase_hist, st.phase_ticks, st.lat_ticks
+    )
+    worst = (st.worst_lat, st.worst_phases, st.worst_key, st.worst_client,
+             st.worst_sub)
+    key_lat_hist, client_lat_hist = st.key_lat_hist, st.client_lat_hist
+    cl_ids_v = jnp.arange(nc, dtype=I32)
     if cfg.metrics:
-        lat_hist = fold_latencies(lat_hist, t - st.clerk_sub, newly)
+        e2e = t - st.clerk_sub
+        lat_hist = fold_latencies(lat_hist, e2e, newly)
+        ph = _shardkv_phase_matrix(
+            t, st.clerk_sub, st.clerk_app, clerk_cmt, clerk_apl,
+            st.clerk_mig, is_get_c,
+        )
+        phase_hist, phase_ticks, lat_ticks = fold_phases(
+            phase_hist, phase_ticks, lat_ticks, ph, e2e, newly
+        )
+        worst = update_worst(
+            worst, e2e, newly, ph, st.clerk_shard, cl_ids_v, st.clerk_sub
+        )
+        key_lat_hist = fold_latencies_by(key_lat_hist, e2e, newly,
+                                         st.clerk_shard)
+        client_lat_hist = fold_latencies_by(client_lat_hist, e2e, newly,
+                                            cl_ids_v)
     # WrongGroup re-query (client.rs:16-25): a marked clerk re-learns NOW
     learn = jax.random.bernoulli(kc[0], skn.p_cfg_learn, (nc,)) | (
         skn.requery_wrong_group & st.clerk_wrong
@@ -1719,10 +1826,18 @@ def _shardkv_service_tick(
     clerk_get_lo = jnp.where(start, truth_at_new, st.clerk_get_lo)
     clerk_get_obs = jnp.where(start, -1, clerk_get_obs)
     clerk_sub = st.clerk_sub
+    clerk_app, clerk_mig = st.clerk_app, st.clerk_mig
     if cfg.metrics:
         clerk_sub = jnp.where(start, t, clerk_sub)  # submit stamp
+        clerk_app = jnp.where(start, 0, clerk_app)
+        clerk_cmt = jnp.where(start, 0, clerk_cmt)
+        clerk_apl = jnp.where(start, 0, clerk_apl)
+        clerk_mig = jnp.where(start, 0, clerk_mig)
     clerk_out = clerk_out | start
     retry = clerk_out & (start | jax.random.bernoulli(kc[3], skn.p_retry, (nc,)))
+    client_retries = st.client_retries
+    if cfg.metrics:
+        client_retries = client_retries + retry.astype(I32)
     tgt_node = jax.random.randint(kc[4], (nc,), 0, n, dtype=I32)
 
     # ---------------------------- service-layer log appends (post-raft-tick)
@@ -1746,7 +1861,9 @@ def _shardkv_service_tick(
         log_term = jnp.where(hit, s.term[..., None], log_term)
         log_val = jnp.where(hit, value_gn[..., None], log_val)
         log_len = jnp.where(ok, log_len + 1, log_len)
-        return log_term, log_val, log_len
+        # ok is returned so metrics stamp sites read the REAL acceptance
+        # mask (a re-derived copy could silently drift from this gate)
+        return log_term, log_val, log_len, ok
 
     # CONFIG advance at the (single chosen) leader node; the entry records
     # which announce variant (live-ctrler) or controller replica
@@ -1755,7 +1872,7 @@ def _shardkv_service_tick(
         node_cfg + 1, adopt_var[:, None],
         src_lim=n if kcfg.computed_ctrler else 2,
     )  # [G, N]
-    log_term, log_val, log_len = append_at(
+    log_term, log_val, log_len, _ = append_at(
         ln_oh & can_advance[:, None] & is_lead, cfg_val,
         log_term, log_val, log_len,
     )
@@ -1765,14 +1882,14 @@ def _shardkv_service_tick(
     inst_ready = want_pull & have_stage  # [G, NS]
     for sh in range(ns):
         v = _pack_install(kcfg, node_cfg, jnp.asarray(sh, I32))
-        log_term, log_val, log_len = append_at(
+        log_term, log_val, log_len, _ = append_at(
             ln_oh & inst_ready[:, sh:sh + 1] & is_lead, v,
             log_term, log_val, log_len,
         )
     # DELETE entries at the old owner on ack.
     for sh in range(ns):
         v = _pack_delete(kcfg, ack_del_cfg[:, sh][:, None], jnp.asarray(sh, I32))
-        log_term, log_val, log_len = append_at(
+        log_term, log_val, log_len, _ = append_at(
             ln_oh & ack_del[:, sh:sh + 1] & is_lead, v,
             log_term, log_val, log_len,
         )
@@ -1807,8 +1924,22 @@ def _shardkv_service_tick(
     retry = retry & ~served
     if cfg.metrics:
         # the bug-mode local serve is an ack too (served requires ~start,
-        # so the op's stamp predates this tick's start update)
-        lat_hist = fold_latencies(lat_hist, t - clerk_sub, served)
+        # so the op's stamp predates this tick's start update); a local
+        # serve skips the log, so its whole latency is the apply phase
+        e2e_s = t - clerk_sub
+        lat_hist = fold_latencies(lat_hist, e2e_s, served)
+        zeros = jnp.zeros_like(e2e_s)
+        ph_s = jnp.stack([zeros, zeros, e2e_s, zeros, zeros])
+        phase_hist, phase_ticks, lat_ticks = fold_phases(
+            phase_hist, phase_ticks, lat_ticks, ph_s, e2e_s, served
+        )
+        worst = update_worst(
+            worst, e2e_s, served, ph_s, clerk_shard, cl_ids_v, clerk_sub
+        )
+        key_lat_hist = fold_latencies_by(key_lat_hist, e2e_s, served,
+                                         clerk_shard)
+        client_lat_hist = fold_latencies_by(client_lat_hist, e2e_s, served,
+                                            cl_ids_v)
     # WrongGroup detection (client.rs:16-25): this submit reached an alive
     # LEADER of the believed owner group and the shard is not serving there
     # — the clerk is marked and (under requery_wrong_group) re-learns the
@@ -1818,9 +1949,19 @@ def _shardkv_service_tick(
     clerk_wrong = jnp.where(
         retry, lead_at_c & (ph_at != OWNED), st.clerk_wrong & ~learn
     )
+    if cfg.metrics:
+        # migration/WrongGroup wait (ISSUE 12): a pre-append tick spent
+        # marked WrongGroup is attributed to the migration phase (carved
+        # out of leader_wait; bounded by the pre-append window, so the
+        # phase sum stays exact)
+        clerk_mig = jnp.where(
+            clerk_out & (clerk_app == 0) & clerk_wrong, clerk_mig + 1,
+            clerk_mig,
+        )
 
     # Client ops at the believed owner's targeted node (leader-gated; a wrong
     # or stale guess commits nothing or a rejected entry — the clerk retries).
+    landed = []
     for c in range(nc):
         sel = (
             (gids_v[:, None] == grp_c[c])
@@ -1829,9 +1970,17 @@ def _shardkv_service_tick(
         )
         v = _pack_op(kcfg, jnp.asarray(c, I32), clerk_seq[c], clerk_shard[c],
                      clerk_kind[c])
-        log_term, log_val, log_len = append_at(
+        log_term, log_val, log_len, ok_c = append_at(
             sel & retry[c], jnp.broadcast_to(v, (g, n)),
             log_term, log_val, log_len,
+        )
+        landed.append(jnp.any(ok_c))
+    if cfg.metrics:
+        # leader_wait boundary (kv.py submit-loop treatment): the first
+        # tick ANY group's leader accepted the op — wrong-group appends
+        # count (the hunt ended; their rejection wait lands in replicate)
+        clerk_app = jnp.where(
+            jnp.stack(landed) & clerk_out & (clerk_app == 0), t, clerk_app
         )
 
     violations = st.violations | viol
@@ -1874,6 +2023,12 @@ def _shardkv_service_tick(
         clerk_get_lo=clerk_get_lo, clerk_get_obs=clerk_get_obs,
         gets_done=gets_done,
         clerk_sub=clerk_sub, lat_hist=lat_hist,
+        clerk_app=clerk_app, clerk_cmt=clerk_cmt, clerk_apl=clerk_apl,
+        clerk_mig=clerk_mig, client_retries=client_retries,
+        phase_hist=phase_hist, phase_ticks=phase_ticks, lat_ticks=lat_ticks,
+        worst_lat=worst[0], worst_phases=worst[1], worst_key=worst[2],
+        worst_client=worst[3], worst_sub=worst[4],
+        key_lat_hist=key_lat_hist, client_lat_hist=client_lat_hist,
         w_frontier=w_frontier, w_cfg=w_cfg, w_phase=w_phase,
         w_hash=w_hash, w_count=w_count, w_last_seq=w_last_seq,
         frz_cfg=frz_cfg, frz_hash=frz_hash,
@@ -1997,6 +2152,22 @@ def shardkv_packed_layout(cfg: SimConfig, kcfg: ShardKvConfig) -> tuple:
         "gets_done": sp.tick,
         "clerk_sub": sp.tick,
         "lat_hist": cnt,             # acked ops are distinct (client, seq)
+        # attribution plane (ISSUE 12)
+        "clerk_app": sp.tick,
+        "clerk_cmt": sp.tick,
+        "clerk_apl": sp.tick,
+        "clerk_mig": sp.tick,        # bounded by elapsed ticks
+        "client_retries": sp.tick,   # at most one attempt per tick
+        "phase_hist": cnt,           # bucket counts <= acked ops
+        "phase_ticks": I32,          # sums of latencies: full width
+        "lat_ticks": I32,
+        "worst_lat": sp.tick,
+        "worst_phases": sp.tick,
+        "worst_key": I32,            # -1 sentinel; full width by design
+        "worst_client": I32,
+        "worst_sub": sp.tick,
+        "key_lat_hist": cnt,
+        "client_lat_hist": cnt,
         "w_frontier": sp.index,
         "w_cfg": num,
         "w_phase": U8,
@@ -2085,6 +2256,21 @@ class PackedShardKvState(NamedTuple):
     gets_done: jax.Array
     clerk_sub: jax.Array
     lat_hist: jax.Array
+    clerk_app: jax.Array
+    clerk_cmt: jax.Array
+    clerk_apl: jax.Array
+    clerk_mig: jax.Array
+    client_retries: jax.Array
+    phase_hist: jax.Array
+    phase_ticks: jax.Array
+    lat_ticks: jax.Array
+    worst_lat: jax.Array
+    worst_phases: jax.Array
+    worst_key: jax.Array
+    worst_client: jax.Array
+    worst_sub: jax.Array
+    key_lat_hist: jax.Array
+    client_lat_hist: jax.Array
     w_frontier: jax.Array
     w_cfg: jax.Array
     w_phase: jax.Array
@@ -2260,6 +2446,20 @@ class ShardKvFuzzReport(NamedTuple):
     # the live controller cluster); None with cfg.metrics off
     lat_hist: Optional[np.ndarray] = None
     ev_counts: Optional[np.ndarray] = None
+    # attribution plane (ISSUE 12): 5-phase decomposition
+    # (config.SHARDKV_PHASES — migration is the extra row), the
+    # per-shard/per-client axes, and the worst-op registers (key = shard)
+    phase_hist: Optional[np.ndarray] = None
+    phase_ticks: Optional[np.ndarray] = None
+    lat_ticks: Optional[np.ndarray] = None
+    key_hist: Optional[np.ndarray] = None
+    client_hist: Optional[np.ndarray] = None
+    client_retries: Optional[np.ndarray] = None
+    worst_lat: Optional[np.ndarray] = None
+    worst_phases: Optional[np.ndarray] = None
+    worst_key: Optional[np.ndarray] = None
+    worst_client: Optional[np.ndarray] = None
+    worst_sub: Optional[np.ndarray] = None
 
     @property
     def n_violating(self) -> int:
@@ -2475,6 +2675,22 @@ def shardkv_report(final: ShardKvState) -> ShardKvFuzzReport:
             np.asarray(final.rafts.ev_counts).sum(axis=1)
             + np.asarray(final.ctrl.ev_counts)
             if final.rafts.ev_counts.size else None
+        ),
+        **(
+            {
+                "phase_hist": np.asarray(final.phase_hist),
+                "phase_ticks": np.asarray(final.phase_ticks),
+                "lat_ticks": np.asarray(final.lat_ticks),
+                "key_hist": np.asarray(final.key_lat_hist),
+                "client_hist": np.asarray(final.client_lat_hist),
+                "client_retries": np.asarray(final.client_retries),
+                "worst_lat": np.asarray(final.worst_lat),
+                "worst_phases": np.asarray(final.worst_phases),
+                "worst_key": np.asarray(final.worst_key),
+                "worst_client": np.asarray(final.worst_client),
+                "worst_sub": np.asarray(final.worst_sub),
+            }
+            if final.lat_hist.size else {}
         ),
     )
 

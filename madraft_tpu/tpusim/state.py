@@ -72,8 +72,14 @@ U32 = jnp.uint32
 #       service append rate via packed_spec_for (a service tick can append
 #       up to n_clients (+ marker) entries per node, so the raft layer's
 #       2-per-tick index bound does not hold there).
+#   4 — the tail-latency attribution plane (ISSUE 12): metrics-on states
+#       and reports gain per-phase histograms (phase_hist/phase_ticks/
+#       lat_ticks), the worst-op register (worst_*), and — on the service
+#       layers — per-key/per-client latency axes; report surfaces gain
+#       `latency.phases` / `latency_phases` / `worst_op` fields. Metrics-
+#       off layouts are byte-identical to v3.
 # Replay/explain JSON carries this plus the layout the run actually used.
-STATE_SCHEMA_VERSION = 3
+STATE_SCHEMA_VERSION = 4
 
 
 class ClusterState(NamedTuple):
@@ -192,6 +198,27 @@ class ClusterState(NamedTuple):
     ev_counts: jax.Array       # i32 [len(METRIC_EVENTS)]: cumulative
     #                            per-lane liveness-event counters in
     #                            config.METRIC_EVENTS order
+    # --- tail-latency attribution plane (ISSUE 12; all zero-size with
+    # cfg.metrics off, incl. the "scalar" register fields, which are [1]
+    # arrays so the off-shape is [0], not a real scalar) ---
+    phase_hist: jax.Array      # i32 [n_phases, HIST_BUCKETS]: per-phase
+    #                            duration histograms (config.LATENCY_PHASES
+    #                            order); every acked op folds one sample
+    #                            into EVERY row (zeros land in bucket 0),
+    #                            so each row's mass == acked ops
+    phase_ticks: jax.Array     # i32 [n_phases]: exact cumulative duration
+    #                            per phase; sum == lat_ticks (the pinned
+    #                            phase-sum==latency invariant, aggregated)
+    lat_ticks: jax.Array       # i32 [1]: exact cumulative end-to-end
+    #                            latency ticks across all folded acks
+    worst_lat: jax.Array       # i32 [1]: argmax-latency op's latency
+    worst_phases: jax.Array    # i32 [n_phases]: its phase vector (sums to
+    #                            worst_lat exactly — the per-op proof the
+    #                            invariant test reads)
+    worst_key: jax.Array       # i32 [1]: its key (-1 for raft commands)
+    worst_client: jax.Array    # i32 [1]: its client (-1 for raft commands)
+    worst_sub: jax.Array       # i32 [1]: its submit tick (0 = register
+    #                            empty; real stamps are >= 1)
 
 
 def durable_after_append(s: ClusterState, new_len: jax.Array) -> jax.Array:
@@ -242,7 +269,7 @@ def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
     if kn is None:
         kn = cfg.knobs()
     n, cap = cfg.n_nodes, cfg.log_cap
-    hb, evn, mcap = metrics_dims(cfg)
+    hb, evn, mcap, nph, reg = metrics_dims(cfg)
     zn = jnp.zeros((n,), I32)
     znn = jnp.zeros((n, n), I32)
     timer = jax.random.randint(
@@ -296,6 +323,14 @@ def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
         shadow_sub=jnp.zeros((mcap,), I32),
         lat_hist=jnp.zeros((hb,), I32),
         ev_counts=jnp.zeros((evn,), I32),
+        phase_hist=jnp.zeros((nph, hb), I32),
+        phase_ticks=jnp.zeros((nph,), I32),
+        lat_ticks=jnp.zeros((reg,), I32),
+        worst_lat=jnp.zeros((reg,), I32),
+        worst_phases=jnp.zeros((nph,), I32),
+        worst_key=jnp.full((reg,), -1, I32),
+        worst_client=jnp.full((reg,), -1, I32),
+        worst_sub=jnp.zeros((reg,), I32),
     )
 
 
@@ -465,6 +500,22 @@ class PackedClusterState(NamedTuple):
     #                                 their clerk-ack folds — ISSUE 11)
     ev_counts: jax.Array            # event dtype (narrow row; see
     #                                 packed_bounds.event)
+    # --- attribution plane (ISSUE 12; zero-size with cfg.metrics off) ---
+    phase_hist: jax.Array           # index dtype (per-phase bucket counts
+    #                                 are bounded by acked ops, like
+    #                                 lat_hist)
+    phase_ticks: jax.Array          # i32 — a SUM of latencies (ops x T)
+    #                                 can outgrow any per-op bound; full
+    #                                 width by design, like msg_count
+    lat_ticks: jax.Array            # i32 (same sum-of-latencies argument)
+    worst_lat: jax.Array            # tick dtype (a latency is <= T)
+    worst_phases: jax.Array         # tick dtype (each phase <= latency)
+    worst_key: jax.Array            # i32 — service-layer key ids with a
+    #                                 -1 sentinel; the raft spec cannot
+    #                                 know the service key alphabet, so
+    #                                 full width by design
+    worst_client: jax.Array         # i32 (same)
+    worst_sub: jax.Array            # tick dtype (a submit stamp, >= 0)
 
 
 def _bit_weights(n: int) -> jax.Array:
@@ -568,6 +619,14 @@ def pack_state(cfg: SimConfig, s: ClusterState,
         shadow_sub=s.shadow_sub.astype(sp.tick),
         lat_hist=s.lat_hist.astype(sp.index),
         ev_counts=s.ev_counts.astype(sp.event),
+        phase_hist=s.phase_hist.astype(sp.index),
+        phase_ticks=s.phase_ticks,
+        lat_ticks=s.lat_ticks,
+        worst_lat=s.worst_lat.astype(sp.tick),
+        worst_phases=s.worst_phases.astype(sp.tick),
+        worst_key=s.worst_key,
+        worst_client=s.worst_client,
+        worst_sub=s.worst_sub.astype(sp.tick),
     )
 
 
@@ -649,6 +708,14 @@ def unpack_state(cfg: SimConfig, p: PackedClusterState,
         shadow_sub=p.shadow_sub.astype(I32),
         lat_hist=p.lat_hist.astype(I32),
         ev_counts=p.ev_counts.astype(I32),
+        phase_hist=p.phase_hist.astype(I32),
+        phase_ticks=p.phase_ticks,
+        lat_ticks=p.lat_ticks,
+        worst_lat=p.worst_lat.astype(I32),
+        worst_phases=p.worst_phases.astype(I32),
+        worst_key=p.worst_key,
+        worst_client=p.worst_client,
+        worst_sub=p.worst_sub.astype(I32),
     )
 
 

@@ -79,7 +79,8 @@ from madraft_tpu.tpusim.config import (
     VIOLATION_LOG_MATCHING,
     VIOLATION_PREFIX_DIVERGE,
 )
-from madraft_tpu.tpusim.metrics import fold_latencies
+from madraft_tpu.tpusim.config import LATENCY_PHASES
+from madraft_tpu.tpusim.metrics import fold_latencies, fold_phases, update_worst
 from madraft_tpu.tpusim.state import (
     ClusterState,
     I32,
@@ -940,8 +941,30 @@ def step_cluster(
     # stamp 0 and are skipped (service layers fold their own clerk-ack
     # latencies instead, kv.py/shardkv.py).
     lat_hist = s.lat_hist
+    phase_hist, phase_ticks, lat_ticks = s.phase_hist, s.phase_ticks, s.lat_ticks
+    worst = (s.worst_lat, s.worst_phases, s.worst_key, s.worst_client,
+             s.worst_sub)
     if cfg.metrics:
-        lat_hist = fold_latencies(lat_hist, t - shadow_sub, shadow_sub > 0)
+        lats = t - shadow_sub
+        rec_mask = shadow_sub > 0
+        lat_hist = fold_latencies(lat_hist, lats, rec_mask)
+        # attribution (ISSUE 12): a raft-injected command is born AT a
+        # leader (leader_wait 0) and its commit is its ack (apply/ack 0),
+        # so its whole latency is the replicate phase — the exact-sum
+        # decomposition degenerates to one leg on this layer. Folding all
+        # four rows keeps the mass invariant (each row's total == acked).
+        zeros = jnp.zeros_like(lats)
+        phases = jnp.stack([
+            lats if name == "replicate" else zeros
+            for name in LATENCY_PHASES
+        ])
+        phase_hist, phase_ticks, lat_ticks = fold_phases(
+            phase_hist, phase_ticks, lat_ticks, phases, lats, rec_mask
+        )
+        worst = update_worst(
+            worst, lats, rec_mask, phases,
+            jnp.full_like(lats, -1), jnp.full_like(lats, -1), shadow_sub,
+        )
 
     # Prefix durability (the long-range extension of the shadow oracle, which
     # only sees the last `cap` committed entries; the round-1 advisory gap):
@@ -1056,4 +1079,12 @@ def step_cluster(
         shadow_sub=shadow_sub,
         lat_hist=lat_hist,
         ev_counts=ev_counts,
+        phase_hist=phase_hist,
+        phase_ticks=phase_ticks,
+        lat_ticks=lat_ticks,
+        worst_lat=worst[0],
+        worst_phases=worst[1],
+        worst_key=worst[2],
+        worst_client=worst[3],
+        worst_sub=worst[4],
     )

@@ -94,6 +94,15 @@ class TickRecord(NamedTuple):
     #                            what the host cross-check recomputes
     lat_hist: jax.Array        # [HIST_BUCKETS] cumulative device histogram
     ev_counts: jax.Array       # [len(METRIC_EVENTS)] cumulative counters
+    # --- attribution plane (ISSUE 12; zero-size with metrics off) ---
+    phase_ticks: jax.Array     # [n_phases] cumulative per-phase tick
+    #                            totals — per-tick deltas become the
+    #                            Perfetto latency_phases counter track
+    worst_lat: jax.Array       # [1] the worst-op register (cumulative
+    worst_phases: jax.Array    # [n_phases] argmax; the LAST tick's values
+    worst_key: jax.Array       # [1] describe the whole trace) — the
+    worst_client: jax.Array    # [1] synthesized worst-op span reads these
+    worst_sub: jax.Array       # [1]
 
 
 def _pack_rows(mat: jax.Array) -> jax.Array:
@@ -140,6 +149,10 @@ def _record(prev: ClusterState, nxt: ClusterState) -> TickRecord:
         violations=nxt.violations,
         shadow_sub=nxt.shadow_sub, lat_hist=nxt.lat_hist,
         ev_counts=nxt.ev_counts,
+        phase_ticks=nxt.phase_ticks,
+        worst_lat=nxt.worst_lat, worst_phases=nxt.worst_phases,
+        worst_key=nxt.worst_key, worst_client=nxt.worst_client,
+        worst_sub=nxt.worst_sub,
     )
 
 
@@ -425,5 +438,47 @@ def chrome_trace(
                 "name": "commit_latency_ticks", "ph": "C", "pid": 0,
                 "ts": ts,
                 "args": {"max": int(lat.max()) if lat.size else 0},
+            })
+        # attribution plane (ISSUE 12): per-phase counter tracks — the
+        # per-tick DELTA of each phase's exact tick total, so a latency
+        # spike's phase shows as a spike in exactly one track
+        from madraft_tpu.tpusim.config import phase_names
+
+        pt = np.asarray(rec.phase_ticks, np.int64)
+        names = phase_names(pt.shape[1])
+        pdeltas = np.diff(
+            np.concatenate([np.zeros((1, pt.shape[1]), np.int64), pt],
+                           axis=0),
+            axis=0,
+        )
+        for ti in range(T):
+            out.append({
+                "name": "latency_phases", "ph": "C", "pid": 0,
+                "ts": (ti + 1) * us,
+                "args": {name: int(pdeltas[ti, k])
+                         for k, name in enumerate(names)},
+            })
+        # synthesized span for the traced worst op: the final tick's
+        # register names the argmax-latency op — render it as a complete
+        # span from its submit tick, phase vector in the args, on its own
+        # track so the tail op is visible against the node timelines
+        w_sub = int(rec.worst_sub[-1][0])
+        if w_sub > 0:
+            w_lat = int(rec.worst_lat[-1][0])
+            out.append({"name": "thread_name", "ph": "M", "pid": 0,
+                        "tid": n, "args": {"name": "worst op"}})
+            out.append({
+                "name": f"worst op ({w_lat} ticks)", "ph": "X", "pid": 0,
+                "tid": n, "ts": w_sub * us, "dur": max(w_lat, 1) * us,
+                "args": {
+                    "latency_ticks": w_lat,
+                    "submit_tick": w_sub,
+                    "key": int(rec.worst_key[-1][0]),
+                    "client": int(rec.worst_client[-1][0]),
+                    "phases": {
+                        name: int(rec.worst_phases[-1][k])
+                        for k, name in enumerate(names)
+                    },
+                },
             })
     return {"traceEvents": out, "displayTimeUnit": "ms"}

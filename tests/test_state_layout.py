@@ -29,7 +29,7 @@ def _rand_state(cfg: SimConfig, rng: np.random.Generator,
     ``boundary``) every bound's exact maximum, so the round-trip test fails
     loudly the day a width stops holding its declared bound."""
     n, cap = cfg.n_nodes, cfg.log_cap
-    hb, evn, mcap = metrics_dims(cfg)
+    hb, evn, mcap, nph, reg = metrics_dims(cfg)
     b = packed_bounds(cfg)
     i32 = lambda x: jnp.asarray(x, jnp.int32)  # noqa: E731
 
@@ -126,6 +126,17 @@ def _rand_state(cfg: SimConfig, rng: np.random.Generator,
         shadow_sub=ints(b.tick, (mcap,)),
         lat_hist=ints(b.index, (hb,)),
         ev_counts=ints(b.event, (evn,)),
+        # attribution plane (ISSUE 12): phase bucket counts index-bounded,
+        # worst-op stamps/durations tick-bounded, the tick-total sums and
+        # the key/client ids full-width i32 by design
+        phase_hist=ints(b.index, (nph, hb)),
+        phase_ticks=i32(rng.integers(0, 2**31, size=(nph,))),
+        lat_ticks=i32(rng.integers(0, 2**31, size=(reg,))),
+        worst_lat=ints(b.tick, (reg,)),
+        worst_phases=ints(b.tick, (nph,)),
+        worst_key=i32(rng.integers(-(2**31), 2**31, size=(reg,))),
+        worst_client=i32(rng.integers(-(2**31), 2**31, size=(reg,))),
+        worst_sub=ints(b.tick, (reg,)),
     )
 
 
