@@ -876,6 +876,52 @@ def cmd_stats(args):
     return 0
 
 
+def cmd_lint(args):
+    """Static analysis over every cached compiled program (ISSUE 15): trace
+    each registry entry to its closed jaxpr (never executing anything) and
+    run the lane-isolation / PRNG-discipline / packed-width /
+    zero-when-off passes. Exit 0 when every traced program is clean, 1 on
+    findings, 2 on usage errors (unknown --program, unwritable --json) —
+    the PR-6 CLI convention."""
+    from madraft_tpu.tpusim import lint as lint_mod
+
+    specs = (lint_mod.defect_registry() if args.selftest
+             else lint_mod.registry())
+    if args.list:
+        for s in specs:
+            legs = f" golden={s.golden_leg}" if s.golden_leg else ""
+            print(f"{s.name}  [{s.family}] lanes={s.n_lanes}{legs}")
+        return 0
+    if args.program:
+        if not any(args.program in s.name for s in specs):
+            print(f"lint: no program matches {args.program!r} "
+                  f"(see lint --list)", file=sys.stderr)
+            raise SystemExit(2)
+    report = lint_mod.run_lint(specs, program=args.program or None)
+    for row in report["programs"]:
+        status = (f"SKIP ({row['skipped']})" if row["skipped"]
+                  else "ok")
+        allowed = (" allowed=" + ",".join(
+            f"{k}x{v}" for k, v in sorted(row["allowed"].items()))
+            if row["allowed"] else "")
+        print(f"{row['name']:<28} eqns={row['eqns']:>6} "
+              f"draws={row['draws']:>3}{allowed}  {status}")
+    for f in report["findings"]:
+        print(f"FINDING {f['program']}: [{f['pass']}/{f['rule']}] "
+              f"{f['detail']}")
+    s = report["summary"]
+    print(f"lint: {s['traced']}/{s['programs']} programs traced "
+          f"({s['skipped']} skipped), {s['findings']} findings")
+    if args.json:
+        try:
+            with open(args.json, "w") as fh:
+                json.dump(report, fh, indent=1)
+        except OSError as e:
+            print(f"lint: {e}", file=sys.stderr)
+            raise SystemExit(2)
+    return 1 if report["findings"] else 0
+
+
 def _top_axis(table: dict, top: int) -> list:
     """The top-N rows of a per-key/per-client axis, worst tail first
     (p99 desc, then ops desc) — the hot-key-skew readout."""
@@ -1231,6 +1277,30 @@ def main(argv=None) -> int:
     sp.add_argument("--top", type=int, default=5,
                     help="N for --by-key/--by-client (default 5)")
     sp.set_defaults(fn=cmd_stats)
+
+    sp = sub.add_parser(
+        "lint",
+        help="static analysis over every cached compiled program: trace "
+             "each ProgramRegistry entry to its jaxpr (no execution) and "
+             "run the lane-isolation / PRNG-discipline / packed-width / "
+             "zero-when-off passes; exit 1 on findings",
+    )
+    sp.add_argument("--platform", default=None,
+                    help="force a JAX backend (e.g. cpu)")
+    sp.add_argument("--program", default="",
+                    help="only lint programs whose name contains this "
+                         "substring (an unknown name exits 2)")
+    sp.add_argument("--json", default="",
+                    help="additionally write the full machine-readable "
+                         "report (schema in MIGRATION.md) to this file")
+    sp.add_argument("--list", action="store_true",
+                    help="list the registry's program names and exit")
+    sp.add_argument("--selftest", action="store_true",
+                    help="lint the planted-defect registry instead: each "
+                         "pass must catch its deliberately-broken program "
+                         "(expected exit 1 — the CI smoke that the "
+                         "analyzer still bites)")
+    sp.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)
     if args.cmd == "stats":

@@ -342,6 +342,10 @@ class PackedBounds(NamedTuple):
 
 
 def packed_bounds(cfg: "SimConfig") -> PackedBounds:
+    # The dtype derivations downstream of these bounds are statically
+    # pinned: tests/test_width_pin.py re-derives the minimal containers
+    # independently, and the lint packed_width pass (tpusim/lint.py)
+    # checks every hot-loop carry against them (ISSUE 15).
     t = cfg.max_lane_ticks
     return PackedBounds(
         tick=t,

@@ -164,6 +164,12 @@ def _mix32(x: jax.Array) -> jax.Array:
     return x ^ (x >> 16)
 
 
+# The bitmap update this index feeds is the ONE declared cross-lane
+# scatter on the coverage hot path: every lane writes the SHARED seen-set
+# through lane-tagged indices. The lint lane_isolation pass (ISSUE 15)
+# flags exactly that pattern, so the coverage chunk registry entries carry
+# an explicit lane_scatter allowance — counted per trace (x1 expected),
+# never silently widened.
 def bitmap_index(ccfg: CoverageConfig, n_nodes: int,
                  code: jax.Array) -> jax.Array:
     """Seen-set bit of an abstract code: the code itself in identity mode,

@@ -39,6 +39,11 @@ from madraft_tpu.tpusim.state import (
 from madraft_tpu.tpusim.step import step_cluster, step_cluster_packed
 
 CLUSTER_AXIS = "clusters"
+# Every cached program factory below (_fuzz_program, _pool_init_program,
+# _chunk_program, the harvest/coverage/replay variants) is enumerated in
+# tpusim/lint.py's ProgramRegistry and statically linted — lane isolation,
+# PRNG discipline, packed widths, zero-when-off (ISSUE 15). A NEW cached
+# program must be registered there; tests/test_lint.py pins the families.
 
 # One device execution = one chunk of the host-looped chunked dispatch
 # (PERF.md round 3: 256-tick compiled scans keep a single execution under the

@@ -1,0 +1,1421 @@
+"""simlint (ISSUE 15): jaxpr-level static analysis over every cached program.
+
+The framework's core guarantees — lane independence, PRNG discipline,
+exact-or-wide packing, zero-cost-when-off metrics/coverage — are enforced
+dynamically by golden guards and bit-identity tests that re-EXECUTE programs.
+This module proves the same structural invariants statically, by tracing each
+cached program to its closed jaxpr (``jax.make_jaxpr`` on abstract inputs —
+never executing anything) and running four lint passes over the equations:
+
+``lane_isolation``
+    Dependency analysis over the lane/cluster batch axis: every value whose
+    axes carry lane identity is tracked through every primitive, and any op
+    that MIXES lanes (reduce/cumsum/sort/partial-slice/concat/gather/scatter/
+    dot along a lane-tagged axis) is a finding — the race-detector analogue
+    for a vectorized simulator. The pool's declared harvest reductions (the
+    monotone-id cumsum + retired count) and the coverage seen-set scatter are
+    per-program ``allow`` rules, so the exceptions are enumerated, not silent.
+
+``prng_discipline``
+    Every ``random_bits`` draw is value-numbered back through its
+    fold/split/seed chain. Two distinct draw sites reaching the SAME key are
+    a finding (key reuse), a draw whose chain never roots in a program input
+    is a finding (constant key), and a draw inside a loop whose key does not
+    depend on that loop's carry is a finding (the same bits every iteration).
+    Draw-parity groups additionally pin that metrics/coverage flags add ZERO
+    draw sites statically — not just trajectory-pinned.
+
+``packed_width``
+    The hot-loop carry (fori/scan) of every packed program is suffix-aligned
+    against the layout-derived expected carry (``jax.eval_shape`` of
+    init+pack): a same-shape-but-wider leaf is a re-widening regression the
+    ``bytes_per_lane`` bench gates only caught after the fact.
+
+``zero_when_off``
+    Metrics-off programs must carry zero-size metric leaves (same alignment,
+    zero-dim expectation), no host callbacks may appear on any hot path, and
+    non-coverage programs must contain no bitmap-sized values.
+
+Trace-only by construction: the registry builds programs through the SAME
+lru-cached factories the CLI uses, but only ever calls ``jax.make_jaxpr`` on
+``ShapeDtypeStruct`` inputs — no compile, no execution, no HLO change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import itertools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import core
+
+from .config import CoverageConfig, SimConfig, storm_profiles
+
+# --------------------------------------------------------------- pass names
+LANE_ISOLATION = "lane_isolation"
+PRNG_DISCIPLINE = "prng_discipline"
+PACKED_WIDTH = "packed_width"
+ZERO_WHEN_OFF = "zero_when_off"
+PASSES = (LANE_ISOLATION, PRNG_DISCIPLINE, PACKED_WIDTH, ZERO_WHEN_OFF)
+
+# rule -> pass. Every finding carries one of these rule names; the pass is
+# derived, so the report can group by either.
+RULE_PASS = {
+    "lane_reduce": LANE_ISOLATION,
+    "lane_cumsum": LANE_ISOLATION,
+    "lane_sort": LANE_ISOLATION,
+    "lane_slice": LANE_ISOLATION,
+    "lane_dus": LANE_ISOLATION,
+    "lane_concat": LANE_ISOLATION,
+    "lane_rev": LANE_ISOLATION,
+    "lane_pad": LANE_ISOLATION,
+    "lane_gather": LANE_ISOLATION,
+    "lane_scatter": LANE_ISOLATION,
+    "lane_contract": LANE_ISOLATION,
+    "lane_branch": LANE_ISOLATION,
+    "key_reuse": PRNG_DISCIPLINE,
+    "constant_key": PRNG_DISCIPLINE,
+    "loop_invariant_draw": PRNG_DISCIPLINE,
+    "draw_parity": PRNG_DISCIPLINE,
+    "wide_carry": PACKED_WIDTH,
+    "narrow_carry": PACKED_WIDTH,
+    "carry_shape_drift": PACKED_WIDTH,
+    "carry_missing": PACKED_WIDTH,
+    "metrics_leak": ZERO_WHEN_OFF,
+    "host_callback": ZERO_WHEN_OFF,
+    "coverage_leak": ZERO_WHEN_OFF,
+}
+
+# host-callback / device-to-host primitives that must never appear on a hot
+# path (the static proxy for "no unexpected syncs": everything else in a
+# jaxpr stays on device by construction)
+_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "debug_print",
+    "infeed", "outfeed", "host_local_array_to_global_array",
+})
+
+# coverage seen-set sizes (default CLI bitmap + the ground-truth bitmap): a
+# non-coverage program carrying a value with one of these dims has had the
+# bitmap threaded into it
+_COVERAGE_DIMS = frozenset({1 << 16, 1 << 14})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding: ``rule`` names the defect, ``lint_pass`` the pass
+    it belongs to (RULE_PASS), ``detail`` the op/leaf evidence."""
+
+    program: str
+    lint_pass: str
+    rule: str
+    detail: str
+
+    def as_dict(self):
+        return {"program": self.program, "pass": self.lint_pass,
+                "rule": self.rule, "detail": self.detail}
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One registry entry: how to build a cached program on ABSTRACT inputs
+    plus the static facts the passes check it against.
+
+    ``build`` returns ``(jitted_fn, args)`` where args mix ShapeDtypeStruct
+    leaves (traced) and concrete knob pytrees — exactly what the CLI would
+    pass, minus any device data. ``n_lanes`` is the lane-axis size (0 for
+    single-cluster programs: the lane pass is vacuous). ``expected_carry``
+    returns the layout-derived carry pytree (ShapeDtypeStructs);
+    ``carry_site`` says where to find the actual carry: the hot loop
+    ("loop") or the program outputs' leading leaves ("out_prefix", for the
+    loop-less init/harvest/unpack programs). ``allow`` enumerates the lane
+    rules this program is DECLARED to hit (harvest reductions, coverage
+    scatter) — hits are counted, not findings. ``draw_group`` names a
+    draw-parity group: all members must have the same static draw-site
+    count. ``golden_leg`` ties the entry to a golden-guard leg in
+    golden_fuzz.json ("clean"/"bug"/"pool") — tests/test_trace.py
+    enumerates the legs through the registry. ``needs_devices`` skips the
+    entry (recorded, not silent) when fewer devices are attached."""
+
+    name: str
+    family: str
+    build: Callable[[], tuple]
+    n_lanes: int = 0
+    metrics_off: bool = True
+    coverage: bool = False
+    expected_carry: Optional[Callable[[], Any]] = None
+    carry_site: str = "loop"
+    allow: frozenset = frozenset()
+    draw_group: Optional[str] = None
+    golden_leg: Optional[str] = None
+    needs_devices: int = 1
+
+
+# =========================================================================
+# the jaxpr interpreter: lane tags + loop-variance + PRNG value numbers
+# =========================================================================
+
+class _Info:
+    """Per-value abstract state: ``tags`` = set of axes carrying lane
+    identity, ``loops`` = set of loop uids the value varies across,
+    ``vn`` = PRNG-relevant value number (hashable)."""
+
+    __slots__ = ("tags", "loops", "vn")
+
+    def __init__(self, tags=frozenset(), loops=frozenset(), vn=("opaque",)):
+        self.tags = tags
+        self.loops = loops
+        self.vn = vn
+
+
+def _lit_key(val):
+    a = np.asarray(val)
+    if a.size <= 4:
+        return ("lit", str(a.dtype), a.shape, tuple(a.reshape(-1).tolist()))
+    return ("lit_big", str(a.dtype), a.shape)
+
+
+def _params_sig(params):
+    """Hashable signature of the simple (non-jaxpr) params, so value
+    numbers distinguish e.g. two slices with different starts."""
+    out = []
+    for k in sorted(params):
+        v = params[k]
+        if isinstance(v, (int, float, bool, str, bytes, type(None))):
+            out.append((k, v))
+        elif isinstance(v, tuple) and all(
+                isinstance(x, (int, float, bool, str)) for x in v):
+            out.append((k, v))
+        else:
+            try:
+                out.append((k, repr(v)))
+            except Exception:
+                pass
+    return tuple(out)
+
+
+_REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "reduce_xor", "argmax", "argmin",
+})
+_CUM_PRIMS = frozenset({
+    "cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp",
+})
+
+
+def _reshape_groups(s_in, s_out):
+    """Map in-axes to out-axes across a reshape, grouping contiguous axes
+    whose products align. Returns a list of (in_axes, out_axes) groups."""
+    groups, i, j = [], 0, 0
+    n_in, n_out = len(s_in), len(s_out)
+    while i < n_in or j < n_out:
+        gi, gj = [i] if i < n_in else [], [j] if j < n_out else []
+        pi = s_in[i] if i < n_in else 1
+        pj = s_out[j] if j < n_out else 1
+        i, j = i + (1 if i < n_in else 0), j + (1 if j < n_out else 0)
+        while pi != pj:
+            if pi < pj and i < n_in:
+                pi *= s_in[i]
+                gi.append(i)
+                i += 1
+            elif pj < pi and j < n_out:
+                pj *= s_out[j]
+                gj.append(j)
+                j += 1
+            else:
+                return None  # ragged; caller falls back
+        groups.append((gi, gj))
+    return groups
+
+
+class _Interp:
+    """One walk of a program's closed jaxpr, collecting findings, draw
+    sites, and the equation census. Loop bodies run to a tag fixpoint with
+    collection off, then once more with collection on — so per-iteration
+    equations are counted once and findings never duplicate."""
+
+    def __init__(self, spec: ProgramSpec):
+        self.spec = spec
+        self.findings: dict = {}      # (rule, detail) -> None (ordered set)
+        self.allowed: dict = {}       # rule -> hit count
+        self.draws: list = []         # (key_vn, path, encl, key_loops, where)
+        self.unknown: set = set()
+        self.n_eqns = 0
+        self._uid = itertools.count()
+
+    # ---------------------------------------------------------------- util
+    def flag(self, collect, rule, detail):
+        if not collect:
+            return
+        if rule in self.spec.allow:
+            self.allowed[rule] = self.allowed.get(rule, 0) + 1
+        else:
+            self.findings[(rule, detail)] = None
+
+    def read(self, env, v):
+        if isinstance(v, core.Literal):
+            return _Info(vn=_lit_key(v.val))
+        info = env.get(v)
+        if info is None:
+            info = _Info(vn=("free", next(self._uid)))
+            env[v] = info
+        return info
+
+    def _const_infos(self, closed):
+        return [_Info(vn=("const", _lit_key(c) if np.asarray(c).size <= 4
+                          else ("big", i)))
+                for i, c in enumerate(closed.consts)]
+
+    # -------------------------------------------------------------- top
+    def run_top(self, closed):
+        n = self.spec.n_lanes
+        args = []
+        for i, v in enumerate(closed.jaxpr.invars):
+            shape = tuple(getattr(v.aval, "shape", ()))
+            tags = frozenset(a for a, d in enumerate(shape) if n and d == n)
+            args.append(_Info(tags=tags, vn=("in", i)))
+        self.run_jaxpr(closed.jaxpr, self._const_infos(closed), args,
+                       True, (), frozenset())
+        self._check_draws()
+
+    def run_jaxpr(self, jaxpr, const_infos, arg_infos, collect, path, encl):
+        env = {}
+        for v, info in zip(jaxpr.constvars, const_infos):
+            env[v] = info
+        for v, info in zip(jaxpr.invars, arg_infos):
+            env[v] = info
+        for eqn in jaxpr.eqns:
+            if collect:
+                self.n_eqns += 1
+            outs = self.eqn(eqn, env, collect, path, encl)
+            for v, o in zip(eqn.outvars, outs):
+                env[v] = o
+        return [self.read(env, v) for v in jaxpr.outvars]
+
+    # ------------------------------------------------------- default rule
+    def _default(self, eqn, ins):
+        """Numpy-style elementwise/broadcast: align trailing dims, union
+        tags on equal-size dims (a size-1 broadcast dim is never the lane
+        axis), union loop-variance, structural value number."""
+        loops = frozenset().union(*(i.loops for i in ins)) if ins \
+            else frozenset()
+        vn = ("eq", eqn.primitive.name, _params_sig(eqn.params),
+              tuple(i.vn for i in ins))
+        outs = []
+        for ov in eqn.outvars:
+            oshape = tuple(getattr(ov.aval, "shape", ()))
+            tags = set()
+            for iv, info in zip(eqn.invars, ins):
+                ishape = tuple(getattr(iv.aval, "shape", ()))
+                off = len(oshape) - len(ishape)
+                for a in info.tags:
+                    if 0 <= a + off < len(oshape) and a < len(ishape) \
+                            and ishape[a] == oshape[a + off]:
+                        tags.add(a + off)
+            outs.append(_Info(frozenset(tags), loops, vn))
+        return outs
+
+    def _sized_fallback(self, eqn, ins):
+        """Unknown primitive: keep soundness by size — any output axis whose
+        size equals the lane count is tagged when ANY input was tagged."""
+        self.unknown.add(eqn.primitive.name)
+        tagged = any(i.tags for i in ins)
+        loops = frozenset().union(*(i.loops for i in ins)) if ins \
+            else frozenset()
+        n = self.spec.n_lanes
+        outs = []
+        for ov in eqn.outvars:
+            shape = tuple(getattr(ov.aval, "shape", ()))
+            tags = frozenset(a for a, d in enumerate(shape)
+                             if tagged and n and d == n)
+            outs.append(_Info(tags, loops, ("opq", next(self._uid))))
+        return outs
+
+    # ------------------------------------------------------------ the eqn
+    def eqn(self, eqn, env, collect, path, encl):
+        prim = eqn.primitive.name
+        ins = [self.read(env, v) for v in eqn.invars]
+        # flat handlers (no sub-jaxpr) need the walk position for draw
+        # bookkeeping; stash it rather than widening every signature
+        self._path, self._encl = path, encl
+
+        if prim == "pjit":
+            cj = eqn.params["jaxpr"]
+            return self.run_jaxpr(cj.jaxpr, self._const_infos(cj), ins,
+                                  collect, path, encl)
+        if prim == "while":
+            return self._while(eqn, ins, collect, path, encl)
+        if prim == "scan":
+            return self._scan(eqn, ins, collect, path, encl)
+        if prim == "cond":
+            return self._cond(eqn, ins, collect, path, encl)
+
+        if prim in _CALLBACK_PRIMS:
+            self.flag(collect, "host_callback",
+                      f"{prim} reaches the compiled program")
+            return [_Info() for _ in eqn.outvars]
+
+        handler = getattr(self, "_p_" + prim.replace("-", "_"), None)
+        if handler is not None:
+            return handler(eqn, ins, collect)
+
+        if prim in _REDUCE_PRIMS:
+            return self._reduce(eqn, ins, collect)
+        if prim in _CUM_PRIMS:
+            return self._cum(eqn, ins, collect)
+        if prim.startswith("scatter"):
+            return self._scatter(eqn, ins, collect)
+
+        # generic call-like primitive carrying a sub-jaxpr with matching
+        # arity (custom_jvp/vjp, remat, closed_call, ...)
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            cj = eqn.params.get(key)
+            if isinstance(cj, core.ClosedJaxpr) \
+                    and len(cj.jaxpr.invars) == len(eqn.invars):
+                return self.run_jaxpr(cj.jaxpr, self._const_infos(cj), ins,
+                                      collect, path, encl)
+            if isinstance(cj, core.Jaxpr) \
+                    and len(cj.invars) == len(eqn.invars):
+                return self.run_jaxpr(
+                    cj, [_Info() for _ in cj.constvars], ins,
+                    collect, path, encl)
+
+        known_default = prim in {
+            "add", "sub", "mul", "div", "rem", "max", "min", "pow", "and",
+            "or", "xor", "not", "neg", "abs", "sign", "floor", "ceil",
+            "round", "exp", "log", "tanh", "logistic", "sqrt", "rsqrt",
+            "integer_pow", "shift_left", "shift_right_logical",
+            "shift_right_arithmetic", "eq", "ne", "lt", "le", "gt", "ge",
+            "select_n", "convert_element_type", "clamp", "erf", "erf_inv",
+            "is_finite", "stop_gradient", "copy", "sharding_constraint",
+            "device_put", "nextafter", "population_count", "clz",
+            "reduce_precision", "real", "imag", "square", "sin", "cos",
+        }
+        if known_default:
+            return self._default(eqn, ins)
+        if all(tuple(getattr(ov.aval, "shape", ())) in
+               [tuple(getattr(iv.aval, "shape", ())) for iv in eqn.invars]
+               for ov in eqn.outvars):
+            # unknown but shape-preserving: elementwise treatment is sound
+            self.unknown.add(prim)
+            return self._default(eqn, ins)
+        return self._sized_fallback(eqn, ins)
+
+    # -------------------------------------------------- structured prims
+    def _while(self, eqn, ins, collect, path, encl):
+        p = eqn.params
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        cj, bj = p["cond_jaxpr"], p["body_jaxpr"]
+        cconsts, bconsts, init = ins[:cn], ins[cn:cn + bn], ins[cn + bn:]
+        uid = next(self._uid)
+        sub_encl = encl | {uid}
+        carry = [_Info(i.tags, i.loops | {uid}, ("carry", uid, k))
+                 for k, i in enumerate(init)]
+        for _ in range(32):  # tag fixpoint (bounded by total rank)
+            outs = self.run_jaxpr(bj.jaxpr, self._const_infos(bj),
+                                  bconsts + carry, False, path, sub_encl)
+            new = [_Info(c.tags | o.tags, c.loops, c.vn)
+                   for c, o in zip(carry, outs)]
+            stable = all(n.tags == c.tags for n, c in zip(new, carry))
+            carry = new
+            if stable:
+                break
+        self.run_jaxpr(cj.jaxpr, self._const_infos(cj), cconsts + carry,
+                       collect, path, sub_encl)
+        self.run_jaxpr(bj.jaxpr, self._const_infos(bj), bconsts + carry,
+                       collect, path, sub_encl)
+        out_loops = frozenset().union(*(i.loops for i in init)) if init \
+            else frozenset()
+        return [_Info(c.tags, out_loops, ("loopout", uid, k))
+                for k, c in enumerate(carry)]
+
+    def _scan(self, eqn, ins, collect, path, encl):
+        p = eqn.params
+        nc, ncar = p["num_consts"], p["num_carry"]
+        cj = p["jaxpr"]
+        consts_i, init, xs = ins[:nc], ins[nc:nc + ncar], ins[nc + ncar:]
+        uid = next(self._uid)
+        sub_encl = encl | {uid}
+        xslices = [_Info(frozenset(a - 1 for a in i.tags if a > 0),
+                         i.loops | {uid}, ("xs", uid, k))
+                   for k, i in enumerate(xs)]
+        carry = [_Info(i.tags, i.loops | {uid}, ("carry", uid, k))
+                 for k, i in enumerate(init)]
+        n_body_out = len(cj.jaxpr.outvars)
+        for _ in range(32):
+            outs = self.run_jaxpr(cj.jaxpr, self._const_infos(cj),
+                                  consts_i + carry + xslices, False, path,
+                                  sub_encl)
+            new = [_Info(c.tags | o.tags, c.loops, c.vn)
+                   for c, o in zip(carry, outs[:ncar])]
+            stable = all(n.tags == c.tags for n, c in zip(new, carry))
+            carry = new
+            if stable:
+                break
+        outs = self.run_jaxpr(cj.jaxpr, self._const_infos(cj),
+                              consts_i + carry + xslices, collect, path,
+                              sub_encl)
+        out_loops = frozenset().union(*(i.loops for i in ins)) if ins \
+            else frozenset()
+        res = [_Info(c.tags, out_loops, ("loopout", uid, k))
+               for k, c in enumerate(carry)]
+        for k, y in enumerate(outs[ncar:n_body_out]):
+            res.append(_Info(frozenset(a + 1 for a in y.tags), out_loops,
+                             ("ys", uid, k)))
+        return res
+
+    def _cond(self, eqn, ins, collect, path, encl):
+        branches = eqn.params["branches"]
+        pred, ops = ins[0], ins[1:]
+        if pred.tags:
+            self.flag(collect, "lane_branch",
+                      "cond predicate carries the lane axis")
+        uid = next(self._uid)
+        per_branch = []
+        for bi, br in enumerate(branches):
+            outs = self.run_jaxpr(br.jaxpr, self._const_infos(br), ops,
+                                  collect, path + ((uid, bi),), encl)
+            per_branch.append(outs)
+        res = []
+        for k in range(len(eqn.outvars)):
+            tags = frozenset().union(*(b[k].tags for b in per_branch))
+            loops = frozenset().union(*(b[k].loops for b in per_branch))
+            vns = {b[k].vn for b in per_branch}
+            vn = vns.pop() if len(vns) == 1 else ("cond", uid, k)
+            res.append(_Info(tags, loops, vn))
+        return res
+
+    # ------------------------------------------------------- shape prims
+    def _p_broadcast_in_dim(self, eqn, ins, collect):
+        bd = eqn.params["broadcast_dimensions"]
+        ishape = tuple(eqn.invars[0].aval.shape)
+        oshape = tuple(eqn.outvars[0].aval.shape)
+        tags = frozenset(bd[a] for a in ins[0].tags
+                         if ishape[a] == oshape[bd[a]])
+        return [_Info(tags, ins[0].loops,
+                      ("eq", "broadcast", _params_sig(eqn.params),
+                       (ins[0].vn,)))]
+
+    def _p_transpose(self, eqn, ins, collect):
+        perm = eqn.params["permutation"]
+        tags = frozenset(j for j, src in enumerate(perm)
+                         if src in ins[0].tags)
+        return [_Info(tags, ins[0].loops,
+                      ("eq", "transpose", tuple(perm), (ins[0].vn,)))]
+
+    def _p_squeeze(self, eqn, ins, collect):
+        dims = set(eqn.params["dimensions"])
+        ishape = tuple(eqn.invars[0].aval.shape)
+        remap, j = {}, 0
+        for a in range(len(ishape)):
+            if a in dims:
+                continue
+            remap[a] = j
+            j += 1
+        tags = frozenset(remap[a] for a in ins[0].tags if a in remap)
+        return [_Info(tags, ins[0].loops,
+                      ("eq", "squeeze", tuple(sorted(dims)), (ins[0].vn,)))]
+
+    def _p_expand_dims(self, eqn, ins, collect):
+        dims = set(eqn.params["dimensions"])
+        orank = len(eqn.outvars[0].aval.shape)
+        remap, j = {}, 0
+        for a in range(orank):
+            if a in dims:
+                continue
+            remap[j] = a
+            j += 1
+        tags = frozenset(remap[a] for a in ins[0].tags if a in remap)
+        return [_Info(tags, ins[0].loops,
+                      ("eq", "expand", tuple(sorted(dims)), (ins[0].vn,)))]
+
+    def _p_reshape(self, eqn, ins, collect):
+        ishape = tuple(eqn.invars[0].aval.shape)
+        oshape = tuple(eqn.outvars[0].aval.shape)
+        groups = _reshape_groups(ishape, oshape)
+        tags = set()
+        if groups is None:
+            if ins[0].tags:
+                n = self.spec.n_lanes
+                tags = {a for a, d in enumerate(oshape) if n and d == n}
+        else:
+            for gi, gj in groups:
+                if any(a in ins[0].tags for a in gi):
+                    tags.update(gj)
+        return [_Info(frozenset(tags), ins[0].loops,
+                      ("eq", "reshape", (ishape, oshape), (ins[0].vn,)))]
+
+    def _p_iota(self, eqn, ins, collect):
+        dim = eqn.params["dimension"]
+        shape = tuple(eqn.outvars[0].aval.shape)
+        n = self.spec.n_lanes
+        tags = frozenset({dim} if n and shape[dim] == n else set())
+        return [_Info(tags, frozenset(),
+                      ("eq", "iota", _params_sig(eqn.params), ()))]
+
+    # --------------------------------------------------- lane-mixing prims
+    def _axes_detail(self, eqn, axes):
+        shapes = [tuple(getattr(v.aval, "shape", ())) for v in eqn.invars]
+        return (f"{eqn.primitive.name} over lane axis "
+                f"{sorted(axes)} of {shapes[0] if shapes else ()}")
+
+    def _reduce(self, eqn, ins, collect):
+        axes = set(eqn.params.get("axes", ()))
+        hit = axes & set(ins[0].tags)
+        if hit:
+            self.flag(collect, "lane_reduce", self._axes_detail(eqn, hit))
+        ishape = tuple(eqn.invars[0].aval.shape)
+        remap, j = {}, 0
+        for a in range(len(ishape)):
+            if a in axes:
+                continue
+            remap[a] = j
+            j += 1
+        tags = frozenset(remap[a] for a in ins[0].tags if a in remap)
+        loops = frozenset().union(*(i.loops for i in ins))
+        vn = ("eq", eqn.primitive.name, tuple(sorted(axes)),
+              tuple(i.vn for i in ins))
+        return [_Info(tags, loops, vn) for _ in eqn.outvars]
+
+    def _cum(self, eqn, ins, collect):
+        axis = eqn.params.get("axis", 0)
+        if axis in ins[0].tags:
+            self.flag(collect, "lane_cumsum", self._axes_detail(eqn, {axis}))
+        return self._default(eqn, ins)
+
+    def _p_sort(self, eqn, ins, collect):
+        dim = eqn.params.get("dimension", -1)
+        if any(dim in i.tags for i in ins):
+            self.flag(collect, "lane_sort", self._axes_detail(eqn, {dim}))
+        loops = frozenset().union(*(i.loops for i in ins))
+        tags = frozenset().union(*(i.tags for i in ins))
+        vn = ("eq", "sort", _params_sig(eqn.params),
+              tuple(i.vn for i in ins))
+        return [_Info(tags, loops, vn) for _ in eqn.outvars]
+
+    def _p_slice(self, eqn, ins, collect):
+        p = eqn.params
+        ishape = tuple(eqn.invars[0].aval.shape)
+        starts, limits = p["start_indices"], p["limit_indices"]
+        strides = p.get("strides") or (1,) * len(ishape)
+        for a in ins[0].tags:
+            if starts[a] != 0 or limits[a] != ishape[a] or strides[a] != 1:
+                self.flag(collect, "lane_slice",
+                          f"partial slice [{starts[a]}:{limits[a]}] on lane "
+                          f"axis {a} of {ishape}")
+        return [_Info(ins[0].tags, ins[0].loops,
+                      ("eq", "slice", _params_sig(p), (ins[0].vn,)))]
+
+    def _p_dynamic_slice(self, eqn, ins, collect):
+        sizes = eqn.params["slice_sizes"]
+        ishape = tuple(eqn.invars[0].aval.shape)
+        for a in ins[0].tags:
+            if sizes[a] != ishape[a]:
+                self.flag(collect, "lane_slice",
+                          f"dynamic_slice size {sizes[a]} on lane axis {a} "
+                          f"of {ishape}")
+        loops = frozenset().union(*(i.loops for i in ins))
+        return [_Info(ins[0].tags, loops,
+                      ("eq", "dslice", _params_sig(eqn.params),
+                       tuple(i.vn for i in ins)))]
+
+    def _p_dynamic_update_slice(self, eqn, ins, collect):
+        oshape = tuple(eqn.invars[0].aval.shape)
+        ushape = tuple(eqn.invars[1].aval.shape)
+        for a in ins[0].tags | ins[1].tags:
+            if a < len(ushape) and ushape[a] != oshape[a]:
+                self.flag(collect, "lane_dus",
+                          f"partial dynamic_update_slice on lane axis {a}: "
+                          f"update {ushape} into {oshape}")
+        loops = frozenset().union(*(i.loops for i in ins))
+        return [_Info(ins[0].tags | ins[1].tags, loops,
+                      ("eq", "dus", (), tuple(i.vn for i in ins)))]
+
+    def _p_rev(self, eqn, ins, collect):
+        dims = set(eqn.params["dimensions"])
+        hit = dims & set(ins[0].tags)
+        if hit:
+            self.flag(collect, "lane_rev", self._axes_detail(eqn, hit))
+        return self._default(eqn, ins)
+
+    def _p_pad(self, eqn, ins, collect):
+        cfgs = eqn.params["padding_config"]
+        for a in ins[0].tags:
+            if cfgs[a] != (0, 0, 0):
+                self.flag(collect, "lane_pad",
+                          f"pad {cfgs[a]} on lane axis {a}")
+        return [_Info(ins[0].tags, ins[0].loops | ins[1].loops,
+                      ("eq", "pad", _params_sig(eqn.params),
+                       (ins[0].vn, ins[1].vn)))]
+
+    def _p_concatenate(self, eqn, ins, collect):
+        dim = eqn.params["dimension"]
+        if any(dim in i.tags for i in ins):
+            self.flag(collect, "lane_concat",
+                      f"concatenate along lane axis {dim}")
+        tags = frozenset().union(*(i.tags for i in ins))
+        loops = frozenset().union(*(i.loops for i in ins))
+        return [_Info(tags, loops,
+                      ("eq", "concat", (dim,), tuple(i.vn for i in ins)))]
+
+    def _p_gather(self, eqn, ins, collect):
+        dn = eqn.params["dimension_numbers"]
+        sizes = eqn.params["slice_sizes"]
+        op, idx = ins[0], ins[1]
+        oshape = tuple(eqn.invars[0].aval.shape)
+        ishape = tuple(eqn.invars[1].aval.shape)
+        out_rank = len(eqn.outvars[0].aval.shape)
+        ob = tuple(getattr(dn, "operand_batching_dims", ()) or ())
+        sib = tuple(getattr(dn, "start_indices_batching_dims", ()) or ())
+        offset_dims = tuple(dn.offset_dims)
+        collapsed = set(dn.collapsed_slice_dims) | set(ob)
+        # which operand dims are indexed into / partially sliced
+        for a in op.tags:
+            if a in ob:
+                continue  # per-lane batched indexing: safe by construction
+            if a in dn.start_index_map:
+                self.flag(collect, "lane_gather",
+                          f"gather indexes INTO lane axis {a} of {oshape}")
+            elif sizes[a] != oshape[a]:
+                self.flag(collect, "lane_gather",
+                          f"gather takes partial slice {sizes[a]} of lane "
+                          f"axis {a} ({oshape})")
+        # output tag mapping
+        try:
+            batch_out = [a for a in range(out_rank) if a not in offset_dims]
+            idx_dims = [a for a in range(len(ishape) - 1)]
+            tags = set()
+            for pos, a in enumerate(batch_out):
+                if pos < len(idx_dims):
+                    src = idx_dims[pos]
+                    if src in idx.tags:
+                        tags.add(a)
+                    if src in sib and ob:
+                        k = sib.index(src)
+                        if k < len(ob) and ob[k] in op.tags:
+                            tags.add(a)
+            full = [a for a in range(len(oshape))
+                    if a not in collapsed and a not in dn.start_index_map]
+            for pos, a in enumerate(full):
+                if pos < len(offset_dims) and a in op.tags \
+                        and sizes[a] == oshape[a]:
+                    tags.add(offset_dims[pos])
+            tags = frozenset(tags)
+        except Exception:
+            n = self.spec.n_lanes
+            tags = frozenset(a for a in range(out_rank)
+                             if n and eqn.outvars[0].aval.shape[a] == n
+                             and (op.tags or idx.tags))
+        loops = op.loops | idx.loops
+        return [_Info(tags, loops,
+                      ("eq", "gather", _params_sig(eqn.params),
+                       (op.vn, idx.vn)))]
+
+    def _scatter(self, eqn, ins, collect):
+        dn = eqn.params["dimension_numbers"]
+        op, idx, upd = ins[0], ins[1], ins[2]
+        oshape = tuple(eqn.invars[0].aval.shape)
+        ob = set(getattr(dn, "operand_batching_dims", ()) or ())
+        sdod = set(dn.scatter_dims_to_operand_dims)
+        for a in op.tags:
+            if a in ob:
+                continue
+            if a in sdod:
+                self.flag(collect, "lane_scatter",
+                          f"{eqn.primitive.name} writes data-dependent "
+                          f"positions of lane axis {a} ({oshape})")
+        sib = set(getattr(dn, "scatter_indices_batching_dims", ()) or ())
+        idx_bad = any(a not in sib for a in idx.tags)
+        if not op.tags and (idx_bad or (upd.tags and not ob)):
+            # lanes writing into a SHARED operand (the coverage seen-set
+            # pattern): cross-lane dataflow through the target. A vmapped
+            # per-lane scatter is NOT this — there the lane axis rides the
+            # batching dims and every lane writes its own row.
+            self.flag(collect, "lane_scatter",
+                      f"{eqn.primitive.name}: lane-tagged indices/updates "
+                      f"write a shared operand {oshape}")
+        loops = op.loops | idx.loops | upd.loops
+        return [_Info(op.tags, loops,
+                      ("eq", eqn.primitive.name, (),
+                       (op.vn, idx.vn, upd.vn)))]
+
+    def _p_dot_general(self, eqn, ins, collect):
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lhs, rhs = ins[0], ins[1]
+        for a in lhs.tags:
+            if a in lc:
+                self.flag(collect, "lane_contract",
+                          f"dot_general contracts lane axis {a} of lhs")
+        for a in rhs.tags:
+            if a in rc:
+                self.flag(collect, "lane_contract",
+                          f"dot_general contracts lane axis {a} of rhs")
+        lshape = tuple(eqn.invars[0].aval.shape)
+        rshape = tuple(eqn.invars[1].aval.shape)
+        tags = set()
+        for pos, (la, ra) in enumerate(zip(lb, rb)):
+            if la in lhs.tags or ra in rhs.tags:
+                tags.add(pos)
+        lfree = [a for a in range(len(lshape)) if a not in lc and a not in lb]
+        rfree = [a for a in range(len(rshape)) if a not in rc and a not in rb]
+        for pos, a in enumerate(lfree):
+            if a in lhs.tags:
+                tags.add(len(lb) + pos)
+        for pos, a in enumerate(rfree):
+            if a in rhs.tags:
+                tags.add(len(lb) + len(lfree) + pos)
+        return [_Info(frozenset(tags), lhs.loops | rhs.loops,
+                      ("eq", "dot", _params_sig(eqn.params),
+                       (lhs.vn, rhs.vn)))]
+
+    # ---------------------------------------------------------- PRNG prims
+    def _p_random_seed(self, eqn, ins, collect):
+        return [_Info(ins[0].tags, ins[0].loops, ("seed", ins[0].vn))]
+
+    def _p_random_wrap(self, eqn, ins, collect):
+        tags = frozenset(a for a in ins[0].tags
+                         if a < len(eqn.invars[0].aval.shape) - 1)
+        return [_Info(tags, ins[0].loops, ins[0].vn)]
+
+    def _p_random_unwrap(self, eqn, ins, collect):
+        return [_Info(ins[0].tags, ins[0].loops, ins[0].vn)]
+
+    def _p_random_fold_in(self, eqn, ins, collect):
+        key, data = ins[0], ins[1]
+        kshape = tuple(eqn.invars[0].aval.shape)
+        dshape = tuple(eqn.invars[1].aval.shape)
+        oshape = tuple(eqn.outvars[0].aval.shape)
+        tags = set()
+        for info, shape in ((key, kshape), (data, dshape)):
+            off = len(oshape) - len(shape)
+            for a in info.tags:
+                if 0 <= a + off < len(oshape) and shape[a] == oshape[a + off]:
+                    tags.add(a + off)
+        return [_Info(frozenset(tags), key.loops | data.loops,
+                      ("fold", key.vn, data.vn))]
+
+    def _p_random_split(self, eqn, ins, collect):
+        return [_Info(ins[0].tags, ins[0].loops, ("split", ins[0].vn))]
+
+    def _p_random_bits(self, eqn, ins, collect):
+        key = ins[0]
+        if collect:
+            kshape = tuple(eqn.invars[0].aval.shape)
+            oshape = tuple(eqn.outvars[0].aval.shape)
+            self.draws.append((key.vn, self._path, self._encl, key.loops,
+                               f"random_bits{oshape} from key{kshape}"))
+        tags = frozenset(a for a in key.tags)  # key batch dims lead
+        return [_Info(tags, key.loops, ("bits", key.vn))]
+
+    # draw bookkeeping needs the walk position; stash it around the dispatch
+    _path: tuple = ()
+    _encl: frozenset = frozenset()
+
+    # ------------------------------------------------------- PRNG findings
+    def _check_draws(self):
+        def vn_roots(vn, acc):
+            if isinstance(vn, tuple):
+                if vn and vn[0] in ("in", "carry", "xs"):
+                    acc.add(vn[0])
+                for x in vn:  # ALL elements: child-vn tuples start at [0]
+                    if isinstance(x, tuple):
+                        vn_roots(x, acc)
+            return acc
+
+        def exclusive(p1, p2):
+            d1 = dict(p1)
+            return any(d1.get(c, b) != b for c, b in p2)
+
+        for i, (vn, path, encl, kloops, where) in enumerate(self.draws):
+            roots = vn_roots(vn, set())
+            if not roots & {"in", "carry", "xs"}:
+                self.findings[("constant_key",
+                               f"{where}: key chain never reaches a program "
+                               f"input")] = None
+            missing = encl - kloops
+            if missing:
+                self.findings[("loop_invariant_draw",
+                               f"{where}: key is invariant across "
+                               f"{len(missing)} enclosing loop(s) — same "
+                               f"bits every iteration")] = None
+            for j in range(i + 1, len(self.draws)):
+                vn2, path2, _, _, where2 = self.draws[j]
+                if vn == vn2 and not exclusive(path, path2):
+                    self.findings[("key_reuse",
+                                   f"{where} and {where2} consume the SAME "
+                                   f"key chain")] = None
+
+
+# =========================================================================
+# pass 3/4: carry-layout alignment against the layout-derived expectation
+# =========================================================================
+
+def _iter_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                if isinstance(sub, core.ClosedJaxpr):
+                    yield from _iter_eqns(sub.jaxpr)
+                elif isinstance(sub, core.Jaxpr):
+                    yield from _iter_eqns(sub)
+
+
+def _loop_carries(jaxpr):
+    """Every (kind, carry avals) loop in the program, recursively."""
+    out = []
+    for eqn in _iter_eqns(jaxpr):
+        if eqn.primitive.name == "while":
+            nc = eqn.params["cond_nconsts"] + eqn.params["body_nconsts"]
+            out.append(("while", [v.aval for v in eqn.invars[nc:]]))
+        elif eqn.primitive.name == "scan":
+            nc, ncar = eqn.params["num_consts"], eqn.params["num_carry"]
+            out.append(("scan", [v.aval for v in eqn.invars[nc:nc + ncar]]))
+    return out
+
+
+def _leaf_names(tree):
+    paths, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [jax.tree_util.keystr(p) or f"[{i}]"
+            for i, (p, _) in enumerate(paths)]
+
+
+def _classify_leaf(spec, name, a, e):
+    ashape, eshape = tuple(a.shape), tuple(e.shape)
+    adt, edt = jnp.dtype(a.dtype), jnp.dtype(e.dtype)
+    if ashape == eshape and adt == edt:
+        return None
+    if 0 in eshape and 0 not in ashape:
+        return ("metrics_leak",
+                f"carry leaf {name}: expected zero-size {eshape} under the "
+                f"off flag, program carries {ashape} {adt}")
+    if ashape == eshape:
+        if adt.itemsize > edt.itemsize:
+            return ("wide_carry",
+                    f"carry leaf {name}: {adt} where the packed layout "
+                    f"derives {edt} ({eshape}) — re-widening regression")
+        return ("narrow_carry",
+                f"carry leaf {name}: {adt} narrower than the layout's "
+                f"{edt} ({eshape})")
+    return ("carry_shape_drift",
+            f"carry leaf {name}: {ashape} {adt} != layout {eshape} {edt}")
+
+
+def _check_carry(spec, closed):
+    if spec.expected_carry is None:
+        return []
+    exp_tree = spec.expected_carry()
+    exp = jax.tree_util.tree_leaves(exp_tree)
+    names = _leaf_names(exp_tree)
+    if spec.carry_site == "out_prefix":
+        actual = [v.aval for v in closed.jaxpr.outvars]
+        if len(actual) < len(exp):
+            return [("carry_missing",
+                     f"program has {len(actual)} outputs, layout expects "
+                     f">= {len(exp)} leading state leaves")]
+        actual = actual[:len(exp)]
+    else:
+        best, best_score = None, -1
+        for kind, carry in _loop_carries(closed.jaxpr):
+            if len(carry) < len(exp):
+                continue
+            tail = carry[-len(exp):]
+            score = sum(
+                1 for a, e in zip(tail, exp)
+                if tuple(a.shape) == tuple(e.shape)
+                and jnp.dtype(a.dtype) == jnp.dtype(e.dtype))
+            if score > best_score:
+                best, best_score = tail, score
+        if best is None:
+            return [("carry_missing",
+                     "no fori/scan loop with a carry at least as large as "
+                     "the layout-derived state found")]
+        actual = best
+    out = []
+    for name, a, e in zip(names, actual, exp):
+        r = _classify_leaf(spec, name, a, e)
+        if r is not None:
+            out.append(r)
+    return out
+
+
+def _check_zero_off(spec, closed):
+    out = []
+    if spec.coverage:
+        return out
+    seen = set()
+    for eqn in _iter_eqns(closed.jaxpr):
+        for v in eqn.outvars:
+            for d in getattr(v.aval, "shape", ()):
+                if d in _COVERAGE_DIMS and d not in seen:
+                    seen.add(d)
+                    out.append((
+                        "coverage_leak",
+                        f"non-coverage program materializes a {d}-bit "
+                        f"seen-set-sized value "
+                        f"({tuple(v.aval.shape)} {v.aval.dtype})"))
+    return out
+
+
+# =========================================================================
+# running the passes
+# =========================================================================
+
+def lint_program(spec: ProgramSpec):
+    """Trace one registry entry and run all four passes. Returns
+    ``(info_dict, [Finding])``; a skipped entry (too few devices) returns
+    an info row with ``skipped`` set and no findings."""
+    info = {"name": spec.name, "family": spec.family,
+            "lanes": spec.n_lanes, "eqns": 0, "draws": 0,
+            "skipped": None, "allowed": {}}
+    ndev = len(jax.devices())
+    if spec.needs_devices > ndev:
+        info["skipped"] = (f"needs {spec.needs_devices} devices, "
+                           f"have {ndev}")
+        return info, [], None
+    fn, args = spec.build()
+    closed = jax.make_jaxpr(fn)(*args)
+    interp = _Interp(spec)
+    interp.run_top(closed)
+    raw = list(interp.findings)
+    raw += _check_carry(spec, closed)
+    raw += _check_zero_off(spec, closed)
+    findings = [Finding(spec.name, RULE_PASS.get(rule, LANE_ISOLATION),
+                        rule, detail)
+                for rule, detail in raw]
+    info["eqns"] = interp.n_eqns
+    info["draws"] = len(interp.draws)
+    info["allowed"] = dict(interp.allowed)
+    return info, findings, interp
+
+
+def run_lint(specs, program: Optional[str] = None):
+    """Run the lint passes over ``specs`` (optionally filtered by substring
+    ``program``) and build the report dict (schema in MIGRATION.md)."""
+    if program is not None:
+        specs = [s for s in specs if program in s.name]
+    infos, findings = [], []
+    group_draws: dict = {}
+    for spec in specs:
+        info, f, _ = lint_program(spec)
+        infos.append(info)
+        findings.extend(f)
+        if spec.draw_group and info["skipped"] is None:
+            group_draws.setdefault(spec.draw_group, []).append(
+                (spec.name, info["draws"]))
+    for group, members in sorted(group_draws.items()):
+        counts = {c for _, c in members}
+        if len(counts) > 1:
+            detail = ", ".join(f"{n}={c}" for n, c in members)
+            for name, c in members:
+                if c != min(counts):
+                    findings.append(Finding(
+                        name, PRNG_DISCIPLINE, "draw_parity",
+                        f"draw-site count diverges within group "
+                        f"{group!r}: {detail}"))
+    per_pass = {p: 0 for p in PASSES}
+    for f in findings:
+        per_pass[f.lint_pass] += 1
+    return {
+        "schema": 1,
+        "programs": infos,
+        "findings": [f.as_dict() for f in findings],
+        "summary": {
+            "programs": len(infos),
+            "traced": sum(1 for i in infos if i["skipped"] is None),
+            "skipped": sum(1 for i in infos if i["skipped"] is not None),
+            "findings": len(findings),
+            "per_pass": per_pass,
+        },
+    }
+
+
+# =========================================================================
+# the program registry
+# =========================================================================
+
+_S = jax.ShapeDtypeStruct
+_I32 = jnp.int32
+_U32 = jnp.uint32
+
+
+def _dims_of(tree):
+    return {int(d) for leaf in jax.tree_util.tree_leaves(tree)
+            for d in leaf.shape}
+
+
+def _batched(tree, n):
+    return jax.tree_util.tree_map(
+        lambda l: _S((n,) + tuple(l.shape), l.dtype), tree)
+
+
+def _pick_lanes(forbidden, even=True):
+    """A lane-axis size that collides with NO other dim in the program's
+    states/configs, so size-based top-level tagging cannot mis-tag an inner
+    axis. Even (mesh- and 2-shard-divisible) candidates first."""
+    cands = (6, 14, 22, 26, 34, 38, 46) if even else (7, 11, 13, 17, 19, 23)
+    for c in cands:
+        if c not in forbidden:
+            return c
+    return 58
+
+
+def _cfg_dims(*cfgs):
+    out = {1, 2, 3, 4, 5, 16, 10}
+    for cfg in cfgs:
+        for f in dataclasses.fields(cfg):
+            v = getattr(cfg, f.name)
+            if isinstance(v, int) and not isinstance(v, bool):
+                out.add(v)
+    return out
+
+
+def registry() -> list:
+    """Every cached compiled program, with its static config and abstract
+    input shapes — the single enumeration the lint passes, the golden
+    guards (tests/test_trace.py), and future optimization-matrix knobs
+    share. Lane counts are auto-picked per family to avoid colliding with
+    any config/state dimension (ShardKvConfig alone has two dims of 6)."""
+    from . import engine
+    from .config import Knobs  # noqa: F401 (knobs ride in concrete)
+    from .ctrler import (CtrlerConfig, _ctrler_program,
+                         _ctrler_replay_program, init_ctrler_cluster,
+                         pack_ctrler_state)
+    from .kv import (KvConfig, _kv_program, _kv_replay_program,
+                     init_kv_cluster, pack_kv_state)
+    from .shardkv import (ShardKvConfig, _shardkv_program,
+                          init_shardkv_cluster, pack_shardkv_state)
+    from .state import init_cluster, pack_state
+    from .trace import _traced_program
+
+    key_sds = _S((2,), _U32)
+
+    # ------------------------------------------------------- raft family
+    cfg = storm_profiles()["durability"][0]
+    cfg_bug = cfg.replace(bug="ack_before_fsync")
+    cfg_m = cfg.replace(metrics=True)
+
+    def one_state(c, packed):
+        def f(k):
+            s = init_cluster(c, k, c.knobs())
+            return pack_state(c, s) if packed else s
+        return jax.eval_shape(f, key_sds)
+
+    wide1 = one_state(cfg, False)
+    packed1 = one_state(cfg, True)
+    forbidden = (_dims_of(wide1) | _dims_of(packed1) | _cfg_dims(cfg))
+    L = _pick_lanes(forbidden)
+
+    def raft_states(c, packed, n):
+        return _batched(one_state(c, packed), n)
+
+    kn = cfg.knobs()
+    ccfg = CoverageConfig().fingerprint_key()
+    harvest_allow = frozenset({"lane_cumsum", "lane_reduce"})
+    cov_allow = frozenset({"lane_scatter"})
+
+    def mesh2():
+        return jax.sharding.Mesh(np.array(jax.devices()[:2]),
+                                 (engine.CLUSTER_AXIS,))
+
+    specs = [
+        ProgramSpec(
+            "fuzz.wide", "fuzz",
+            lambda: (engine._fuzz_program(cfg.static_key(), L, None, False),
+                     (_S((), _I32), kn, _S((), _I32))),
+            n_lanes=L, expected_carry=lambda: raft_states(cfg, False, L),
+            draw_group="raft.fuzz", golden_leg="clean"),
+        ProgramSpec(
+            "fuzz.bug", "fuzz",
+            lambda: (engine._fuzz_program(cfg_bug.static_key(), L, None,
+                                          False),
+                     (_S((), _I32), cfg_bug.knobs(), _S((), _I32))),
+            n_lanes=L,
+            expected_carry=lambda: raft_states(cfg_bug, False, L),
+            draw_group="raft.fuzz", golden_leg="bug"),
+        ProgramSpec(
+            "fuzz.metrics", "fuzz",
+            lambda: (engine._fuzz_program(cfg_m.static_key(), L, None,
+                                          False),
+                     (_S((), _I32), cfg_m.knobs(), _S((), _I32))),
+            n_lanes=L, metrics_off=False,
+            expected_carry=lambda: raft_states(cfg_m, False, L),
+            draw_group="raft.fuzz"),
+        ProgramSpec(
+            "fuzz.percluster", "fuzz",
+            lambda: (engine._fuzz_program(cfg.static_key(), L, None, True),
+                     (_S((), _I32), kn.broadcast(L), _S((), _I32))),
+            n_lanes=L, expected_carry=lambda: raft_states(cfg, False, L),
+            draw_group="raft.fuzz"),
+        ProgramSpec(
+            "fuzz.sharded", "fuzz",
+            lambda: (engine._fuzz_program(cfg.static_key(), L, mesh2(),
+                                          False),
+                     (_S((), _I32), kn, _S((), _I32))),
+            n_lanes=L, expected_carry=lambda: raft_states(cfg, False, L),
+            draw_group="raft.fuzz", needs_devices=2),
+        ProgramSpec(
+            "sweep.uniform_cell", "sweep",
+            lambda: (engine._uniform_cell_program(cfg.static_key(), L),
+                     (_S((), _I32), kn, _S((), _I32), _S((), _I32))),
+            n_lanes=L, expected_carry=lambda: raft_states(cfg, False, L),
+            draw_group="raft.fuzz"),
+    ]
+
+    for packed in (True, False):
+        tag = "packed" if packed else "wide"
+        specs += [
+            ProgramSpec(
+                f"pool.init.{tag}", "pool",
+                functools.partial(
+                    lambda p: (engine._pool_init_program(
+                        cfg.static_key(), L, None, p),
+                        (_S((), _I32), kn, _S((), _I32))), packed),
+                n_lanes=L, carry_site="out_prefix",
+                expected_carry=functools.partial(raft_states, cfg, packed,
+                                                 L),
+                draw_group="raft.init"),
+            ProgramSpec(
+                f"pool.chunk.{tag}", "pool",
+                functools.partial(
+                    lambda p: (engine._chunk_program(cfg.static_key(), L, p),
+                               (raft_states(cfg, p, L), _S((L, 2), _U32),
+                                kn, _S((), _I32))), packed),
+                n_lanes=L,
+                expected_carry=functools.partial(raft_states, cfg, packed,
+                                                 L),
+                draw_group="raft.chunk",
+                golden_leg="pool" if packed else None),
+            ProgramSpec(
+                f"pool.harvest.{tag}", "pool",
+                functools.partial(
+                    lambda p: (engine._harvest_program(cfg.static_key(), L,
+                                                       p),
+                               (raft_states(cfg, p, L), _S((L, 2), _U32),
+                                _S((L,), _I32), _S((), _I32), _S((), _I32),
+                                kn, _S((), _I32))), packed),
+                n_lanes=L, carry_site="out_prefix",
+                expected_carry=functools.partial(raft_states, cfg, packed,
+                                                 L),
+                allow=harvest_allow, draw_group="raft.harvest"),
+        ]
+
+    specs += [
+        ProgramSpec(
+            "pool.chunk.metrics", "pool",
+            lambda: (engine._chunk_program(cfg_m.static_key(), L, True),
+                     (raft_states(cfg_m, True, L), _S((L, 2), _U32),
+                      cfg_m.knobs(), _S((), _I32))),
+            n_lanes=L, metrics_off=False,
+            expected_carry=lambda: raft_states(cfg_m, True, L),
+            draw_group="raft.chunk"),
+        ProgramSpec(
+            "pool.lane_harvest.packed", "pool",
+            lambda: (engine._lane_harvest_program(cfg.static_key(), L, None,
+                                                  True),
+                     (raft_states(cfg, True, L), _S((L, 2), _U32),
+                      _S((L,), _I32), _S((L,), _I32), _S((), _I32), kn,
+                      _S((), _I32))),
+            n_lanes=L, carry_site="out_prefix",
+            expected_carry=lambda: raft_states(cfg, True, L),
+            allow=harvest_allow, draw_group="raft.harvest"),
+        ProgramSpec(
+            "pool.unpack_batch", "pool",
+            lambda: (engine._unpack_batch_program(cfg.static_key(), L),
+                     (raft_states(cfg, True, L),)),
+            n_lanes=L, carry_site="out_prefix",
+            expected_carry=lambda: raft_states(cfg, False, L)),
+        ProgramSpec(
+            "cov.chunk.packed", "coverage",
+            lambda: (engine._cov_chunk_program(cfg.static_key(), L, ccfg,
+                                               True),
+                     (raft_states(cfg, True, L), _S((L, 2), _U32),
+                      kn.broadcast(L), _S((ccfg.bitmap_bits,), jnp.bool_),
+                      _S((L,), _I32), _S((), _I32))),
+            n_lanes=L, coverage=True, allow=cov_allow,
+            expected_carry=lambda: (
+                raft_states(cfg, True, L),
+                _S((ccfg.bitmap_bits,), jnp.bool_), _S((L,), _I32)),
+            draw_group="raft.chunk"),
+        ProgramSpec(
+            "cov.harvest.packed", "coverage",
+            lambda: (engine._cov_harvest_program(cfg.static_key(), L, ccfg,
+                                                 True),
+                     (raft_states(cfg, True, L), _S((L, 2), _U32),
+                      _S((L,), _I32), kn.broadcast(L), _S((L,), _I32),
+                      _S((L,), _I32), _S((ccfg.bitmap_bits,), jnp.bool_),
+                      _S((), _I32), _S((), _I32), kn, _S((), _I32))),
+            n_lanes=L, coverage=True, carry_site="out_prefix",
+            expected_carry=lambda: raft_states(cfg, True, L),
+            allow=harvest_allow | cov_allow,
+            draw_group="raft.cov_harvest"),
+        ProgramSpec(
+            "cov.chunk.sharded", "coverage",
+            lambda: (engine._cov_chunk_sharded_program(cfg.static_key(), L,
+                                                       ccfg, 2, True),
+                     (raft_states(cfg, True, L), _S((L, 2), _U32),
+                      kn.broadcast(L),
+                      _S((2, ccfg.bitmap_bits), jnp.bool_),
+                      _S((L,), _I32), _S((), _I32))),
+            n_lanes=L, coverage=True, allow=cov_allow,
+            expected_carry=lambda: (
+                raft_states(cfg, True, L),
+                _S((2, ccfg.bitmap_bits), jnp.bool_), _S((L,), _I32)),
+            draw_group="raft.chunk"),
+        ProgramSpec(
+            "cov.harvest.sharded", "coverage",
+            lambda: (engine._cov_harvest_sharded_program(
+                cfg.static_key(), L, ccfg, mesh2(), True),
+                (raft_states(cfg, True, L), _S((L, 2), _U32),
+                 _S((L,), _I32), _S((L,), _I32), kn.broadcast(L),
+                 _S((L,), _I32), _S((L,), _I32),
+                 _S((2, ccfg.bitmap_bits), jnp.bool_),
+                 _S((), _I32), kn, _S((), _I32))),
+            n_lanes=L, coverage=True, carry_site="out_prefix",
+            expected_carry=lambda: raft_states(cfg, True, L),
+            allow=harvest_allow | cov_allow,
+            draw_group="raft.cov_harvest", needs_devices=2),
+    ]
+
+    for packed in (True, False):
+        tag = "packed" if packed else "wide"
+        specs += [
+            ProgramSpec(
+                f"replay.{tag}", "replay",
+                functools.partial(
+                    lambda p: (engine._replay_program(cfg.static_key(), p),
+                               (_S((), _I32), kn, _S((), _I32),
+                                _S((), _I32))), packed),
+                expected_carry=functools.partial(one_state, cfg, packed),
+                draw_group="raft.replay"),
+            ProgramSpec(
+                f"trace.{tag}", "trace",
+                functools.partial(
+                    lambda p: (_traced_program(cfg.static_key(), 8, p),
+                               (_S((), _I32), kn, _S((), _I32))), packed),
+                expected_carry=functools.partial(one_state, cfg, packed),
+                draw_group="raft.replay"),
+        ]
+
+    # ---------------------------------------------------- service layers
+    def service_family(prefix, scfg, kcfg, program, replay, init_fn,
+                       pack_fn, extra_knobs, group, n_extra_init=()):
+        def one(packed):
+            def f(k):
+                s = init_fn(scfg, kcfg, k, scfg.knobs(), *n_extra_init)
+                return pack_fn(scfg, kcfg, s) if packed else s
+            return jax.eval_shape(f, key_sds)
+
+        forb = (_dims_of(one(False)) | _dims_of(one(True))
+                | _cfg_dims(scfg, kcfg))
+        n = _pick_lanes(forb)
+        out = []
+        for packed in (True, False):
+            tag = "packed" if packed else "wide"
+            out.append(ProgramSpec(
+                f"{prefix}.fuzz.{tag}", prefix,
+                functools.partial(
+                    lambda p: (program(scfg.static_key(), kcfg, n, None,
+                                       False, p),
+                               (_S((), _I32), scfg.knobs(), extra_knobs,
+                                _S((), _I32))), packed),
+                n_lanes=n,
+                expected_carry=functools.partial(
+                    lambda p: _batched(one(p), n), packed),
+                draw_group=group))
+        if replay is not None:
+            out.append(ProgramSpec(
+                f"{prefix}.replay.packed", prefix,
+                lambda: (replay(scfg.static_key(), kcfg, True),
+                         (_S((), _I32), scfg.knobs(), extra_knobs,
+                          _S((), _I32), _S((), _I32))),
+                expected_carry=functools.partial(one, True)))
+        return out
+
+    svc_cfg = SimConfig()
+    kcfg = KvConfig()
+    specs += service_family("kv", svc_cfg, kcfg, _kv_program,
+                            _kv_replay_program, init_kv_cluster,
+                            pack_kv_state, kcfg.knobs(), "kv.fuzz")
+    ctcfg = CtrlerConfig()
+    specs += service_family("ctrler", svc_cfg, ctcfg, _ctrler_program,
+                            _ctrler_replay_program, init_ctrler_cluster,
+                            pack_ctrler_state, ctcfg.knobs(), "ctrler.fuzz")
+    sk_scfg = SimConfig(n_nodes=3)
+    skcfg = ShardKvConfig()
+    specs += service_family(
+        "shardkv", sk_scfg, skcfg, _shardkv_program, None,
+        init_shardkv_cluster, pack_shardkv_state, skcfg.knobs(),
+        "shardkv.fuzz", n_extra_init=(skcfg.knobs(),))
+    return specs
+
+
+def golden_guard_legs() -> dict:
+    """leg -> [program names]: which golden_fuzz.json legs the registry's
+    entries pin. tests/test_trace.py enumerates its guard legs through
+    this, so a new program family cannot silently dodge the guards."""
+    legs: dict = {}
+    for s in registry():
+        if s.golden_leg:
+            legs.setdefault(s.golden_leg, []).append(s.name)
+    return legs
+
+
+# =========================================================================
+# planted defects: each pass's testbed (lint --selftest traces these)
+# =========================================================================
+
+def defect_registry() -> list:
+    """Four deliberately-broken programs, one per pass class. The lint CLI
+    exposes them via ``--selftest`` (expected exit 1) so CI can prove the
+    analyzer still catches each defect class without shipping a broken
+    production program."""
+    L = 6
+
+    def cross_lane():
+        def run(x, n):
+            # a cluster peeking at its neighbor's state every tick
+            return jax.lax.fori_loop(
+                0, n, lambda _, c: jnp.roll(c, 1, axis=0) + 1, x)
+        return (jax.jit(run),
+                (_S((L, 4), jnp.float32), _S((), _I32)))
+
+    def key_reuse():
+        def run(seed):
+            k = jax.random.PRNGKey(seed)
+            # two independent consumers of the SAME key
+            return (jax.random.uniform(k, (4,))
+                    + jax.random.normal(k, (4,)))
+        return jax.jit(run), (_S((), _I32),)
+
+    def metrics_leak():
+        def run(t, hist, n):
+            # a metrics histogram carried although metrics is OFF
+            return jax.lax.fori_loop(
+                0, n, lambda _, c: (c[0] + 1, c[1] + 1), (t, hist))
+        return (jax.jit(run),
+                (_S((L,), _I32), _S((L, 16), _I32), _S((), _I32)))
+
+    def wide_carry():
+        def run(x, n):
+            # an in-bounds counter (<= 255) carried at i32 instead of u8
+            return jax.lax.fori_loop(
+                0, n, lambda _, c: (c + 1) % 200, x)
+        return jax.jit(run), (_S((L,), _I32), _S((), _I32))
+
+    return [
+        ProgramSpec("defect.cross_lane_roll", "defect", cross_lane,
+                    n_lanes=L,
+                    expected_carry=lambda: _S((L, 4), jnp.float32)),
+        ProgramSpec("defect.key_reuse", "defect", key_reuse),
+        ProgramSpec("defect.metrics_leak", "defect", metrics_leak,
+                    n_lanes=L,
+                    expected_carry=lambda: (_S((L,), _I32),
+                                            _S((L, 0), _I32))),
+        ProgramSpec("defect.wide_carry", "defect", wide_carry,
+                    n_lanes=L,
+                    expected_carry=lambda: _S((L,), jnp.uint8)),
+    ]

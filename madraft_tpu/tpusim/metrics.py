@@ -47,7 +47,9 @@ def fold_latencies(hist: jnp.ndarray, lat: jnp.ndarray,
     """Device-side fold: add each masked latency's bucket to ``hist``
     ([HIST_BUCKETS] i32). ``lat``/``mask`` are any matching shape; the
     fold is a one-hot sum (no scatters — the TPU idiom everywhere else in
-    the step)."""
+    the step). Draw-free by construction, and statically so: the lint
+    draw-parity groups (tpusim/lint.py) pin metrics-on programs to the
+    same random_bits site count as metrics-off."""
     edges = jnp.asarray(BUCKET_EDGES, I32)
     flat_lat = lat.reshape(-1)
     flat_mask = mask.reshape(-1)

@@ -411,7 +411,12 @@ def packed_spec_for(cfg: SimConfig, index_bound: Optional[int] = None,
     layer's 2-per-tick index bound and n*(T+1) cmd bound do not hold for
     the raft group embedded in a service carry. Each service module derives
     its own bounds from its static config and packs its raft sub-state with
-    this spec; the default (both None) is exactly the raft-layer spec."""
+    this spec; the default (both None) is exactly the raft-layer spec.
+
+    Width regressions here are caught statically (ISSUE 15): the lint
+    packed_width pass audits every cached program's carry dtypes against
+    this spec, and tests/test_width_pin.py re-derives the minimal dtype
+    per field from packed_bounds and pins the full field->dtype map."""
     b = packed_bounds(cfg)
     cmd_dt = _uint_for((b.cmd if cmd_bound is None else cmd_bound) + 1)
     # + 1 reserves a distinct NOOP sentinel

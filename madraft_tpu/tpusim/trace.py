@@ -160,6 +160,10 @@ def _record(prev: ClusterState, nxt: ClusterState) -> TickRecord:
 def _traced_program(static_cfg: SimConfig, n_ticks: int,
                     packed: bool = False):
     """One compiled traced-replay program per (static shape, tick count).
+    Registered (packed and wide) in tpusim/lint.py's ProgramRegistry in
+    the raft.replay draw-parity group: tracing must add zero draws, so
+    the traced program's static draw-site count must equal the untraced
+    replayer's — checked statically on every lint run (ISSUE 15).
     The scan length must be static (it shapes the stacked outputs), so
     n_ticks joins the cache key — fine for single-cluster replay. With
     ``packed`` the scan CARRY is the packed schema the pool/chunk programs

@@ -1,0 +1,209 @@
+"""Static analysis suite (ISSUE 15): the clean registry lints green, each
+planted defect is caught by exactly its pass class, the report schema is
+stable, and the `lint` CLI verb keeps the PR-6 exit-code convention
+(0 = clean, 1 = findings, 2 = usage error). Everything here is trace-only:
+no registry program is ever executed."""
+
+import contextlib
+import io
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from madraft_tpu.__main__ import main
+from madraft_tpu.tpusim.lint import (
+    LANE_ISOLATION,
+    PACKED_WIDTH,
+    PRNG_DISCIPLINE,
+    RULE_PASS,
+    ZERO_WHEN_OFF,
+    ProgramSpec,
+    defect_registry,
+    golden_guard_legs,
+    registry,
+    run_lint,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parent
+
+
+@pytest.fixture(scope="module")
+def clean_report():
+    # One full-registry lint for the whole module (each entry traces its
+    # jaxpr once; ~15 s for all programs — cheap next to a compile, and
+    # nothing executes).
+    return run_lint(registry())
+
+
+def run_cli(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        try:
+            rc = main(argv)
+        except SystemExit as e:
+            rc = e.code
+    return rc, buf.getvalue()
+
+
+# ------------------------------------------------------------ clean tree
+def test_registry_lints_green(clean_report):
+    assert clean_report["findings"] == [], (
+        "the production registry must lint clean: "
+        + "; ".join(f"{f['program']}: {f['rule']} {f['detail']}"
+                    for f in clean_report["findings"])
+    )
+    s = clean_report["summary"]
+    # conftest forces a 2-virtual-device CPU mesh, so even the sharded
+    # entries trace — nothing may be skipped in CI
+    assert s["skipped"] == 0 and s["traced"] == s["programs"]
+    assert set(s["per_pass"]) == {LANE_ISOLATION, PRNG_DISCIPLINE,
+                                  PACKED_WIDTH, ZERO_WHEN_OFF}
+
+
+def test_registry_enumerates_every_program_family(clean_report):
+    names = [p["name"] for p in clean_report["programs"]]
+    assert len(names) == len(set(names)), "duplicate program names"
+    families = {p["family"] for p in clean_report["programs"]}
+    # the tentpole's coverage list: fuzz / sweep / pool chunk + harvest +
+    # init / coverage / kv / ctrler / shardkv / trace / replay
+    assert {"fuzz", "sweep", "pool", "coverage", "replay", "trace",
+            "kv", "ctrler", "shardkv"} <= families
+    # packed AND wide variants, single-device AND sharded
+    assert any(n.endswith(".packed") for n in names)
+    assert any(n.endswith(".wide") for n in names)
+    assert any("sharded" in n for n in names)
+    assert len(names) >= 30
+
+
+def test_declared_exceptions_are_counted_not_flagged(clean_report):
+    # harvest's cross-lane reductions and the coverage bitmap scatter are
+    # DECLARED hits: they must show up in the allowed counts (proof the
+    # lane pass actually sees them) and never as findings
+    rows = {p["name"]: p["allowed"] for p in clean_report["programs"]}
+    assert rows["pool.harvest.packed"].get("lane_reduce", 0) >= 1
+    assert rows["pool.harvest.packed"].get("lane_cumsum", 0) >= 1
+    assert rows["cov.chunk.packed"].get("lane_scatter", 0) >= 1
+
+
+def test_metrics_and_coverage_add_zero_draws(clean_report):
+    # the static form of the golden guard's "metrics/coverage change no
+    # draw layout": same draw-site count across each program group
+    draws = {p["name"]: p["draws"] for p in clean_report["programs"]}
+    assert draws["fuzz.wide"] == draws["fuzz.metrics"]
+    assert (draws["pool.chunk.packed"] == draws["pool.chunk.metrics"]
+            == draws["cov.chunk.packed"])
+
+
+# -------------------------------------------------------- planted defects
+def test_each_pass_catches_its_planted_defect():
+    report = run_lint(defect_registry())
+    by_prog = {}
+    for f in report["findings"]:
+        by_prog.setdefault(f["program"], set()).add((f["pass"], f["rule"]))
+    expect = {
+        "defect.cross_lane_roll": LANE_ISOLATION,
+        "defect.key_reuse": PRNG_DISCIPLINE,
+        "defect.metrics_leak": ZERO_WHEN_OFF,
+        "defect.wide_carry": PACKED_WIDTH,
+    }
+    for prog, want_pass in expect.items():
+        passes = {p for p, _ in by_prog.get(prog, set())}
+        assert want_pass in passes, (
+            f"{prog}: pass {want_pass!r} missed its defect "
+            f"(findings: {by_prog.get(prog)})"
+        )
+    assert ("prng_discipline", "key_reuse") in by_prog["defect.key_reuse"]
+    assert ("zero_when_off", "metrics_leak") in by_prog["defect.metrics_leak"]
+    assert ("packed_width", "wide_carry") in by_prog["defect.wide_carry"]
+
+
+def test_draw_parity_flags_a_diverging_group():
+    # a pair in the same draw group where one member draws twice: the
+    # extra-draw member (and only it) must get a draw_parity finding
+    def one_draw():
+        def run(seed):
+            return jax.random.uniform(jax.random.PRNGKey(seed), (4,))
+        return jax.jit(run), (jax.ShapeDtypeStruct((), jnp.int32),)
+
+    def two_draws():
+        def run(seed):
+            k = jax.random.PRNGKey(seed)
+            k1, k2 = jax.random.split(k)
+            return (jax.random.uniform(k1, (4,))
+                    + jax.random.uniform(k2, (4,)))
+        return jax.jit(run), (jax.ShapeDtypeStruct((), jnp.int32),)
+
+    specs = [
+        ProgramSpec("parity.base", "parity", one_draw, draw_group="g"),
+        ProgramSpec("parity.extra", "parity", two_draws, draw_group="g"),
+    ]
+    report = run_lint(specs)
+    flagged = {f["program"] for f in report["findings"]
+               if f["rule"] == "draw_parity"}
+    assert flagged == {"parity.extra"}
+
+
+# ---------------------------------------------------------- report schema
+def test_report_schema(clean_report):
+    # the MIGRATION.md-documented shape: CI consumers key on these
+    assert clean_report["schema"] == 1
+    assert set(clean_report) == {"schema", "programs", "findings", "summary"}
+    for row in clean_report["programs"]:
+        assert {"name", "family", "lanes", "eqns", "draws", "skipped",
+                "allowed"} <= set(row)
+        assert row["eqns"] > 0
+    s = clean_report["summary"]
+    assert {"programs", "traced", "skipped", "findings", "per_pass"} == set(s)
+    report = run_lint(defect_registry())
+    for f in report["findings"]:
+        assert set(f) == {"program", "pass", "rule", "detail"}
+        assert RULE_PASS[f["rule"]] == f["pass"]
+
+
+def test_golden_guard_legs_cover_the_golden_file():
+    legs = golden_guard_legs()
+    golden = json.loads((ROOT / "golden_fuzz.json").read_text())
+    golden.pop("_comment", None)
+    assert set(legs) == set(golden), (
+        "registry golden legs must match golden_fuzz.json exactly"
+    )
+    names = {s.name for s in registry()}
+    for leg, progs in legs.items():
+        assert progs and set(progs) <= names, (leg, progs)
+
+
+# ------------------------------------------------------------- CLI verb
+def test_cli_clean_program_exits_zero():
+    rc, out = run_cli(["lint", "--program", "fuzz.wide"])
+    assert rc == 0
+    assert "fuzz.wide" in out and "0 findings" in out
+
+
+def test_cli_selftest_exits_one_and_reports_json(tmp_path):
+    out_file = tmp_path / "lint_report.json"
+    rc, out = run_cli(["lint", "--selftest", "--json", str(out_file)])
+    assert rc == 1, "planted defects must produce finding-exit 1"
+    assert "FINDING" in out
+    report = json.loads(out_file.read_text())
+    assert report["schema"] == 1 and report["findings"]
+
+
+def test_cli_usage_errors_exit_two(tmp_path):
+    # unknown --program: usage error, NOT a finding
+    rc, _ = run_cli(["lint", "--program", "no_such_program"])
+    assert rc == 2
+    # unwritable --json report path: same convention
+    rc, _ = run_cli(["lint", "--program", "fuzz.wide",
+                     "--json", str(tmp_path / "missing_dir" / "r.json")])
+    assert rc == 2
+
+
+def test_cli_list_enumerates_without_tracing():
+    rc, out = run_cli(["lint", "--list"])
+    assert rc == 0
+    lines = [ln for ln in out.strip().splitlines() if ln]
+    assert len(lines) == len(registry())
+    assert any("golden=pool" in ln for ln in lines)

@@ -20,6 +20,7 @@ from madraft_tpu.tpusim.config import (
     violation_names,
 )
 from madraft_tpu.tpusim.engine import replay_cluster
+from madraft_tpu.tpusim.lint import golden_guard_legs
 from madraft_tpu.tpusim.trace import (
     alive_masks,
     chrome_trace,
@@ -38,6 +39,20 @@ DURABILITY = _PROFILES["durability"][0]
 # 157 today) so a deliberate golden regeneration cannot strand stale
 # coordinates here
 _GOLDEN = json.loads((ROOT / "golden_fuzz.json").read_text())
+# The guard legs come from the ProgramRegistry (ISSUE 15), not a hand list:
+# every registry entry tagged with a golden_leg is guarded here, so a new
+# program family cannot silently dodge the golden guards. A fuzz leg pins a
+# one-shot "report", a pool leg the pool run's "summary" (the golden file's
+# own shape says which); the completeness check below fails collection-time
+# if a registry leg has no golden entry or vice versa.
+_GUARD_LEGS = golden_guard_legs()
+assert set(_GUARD_LEGS) == {k for k in _GOLDEN if k != "_comment"}, (
+    "registry golden legs and golden_fuzz.json drifted apart: "
+    f"{sorted(_GUARD_LEGS)} vs {sorted(k for k in _GOLDEN if k != '_comment')}"
+)
+_FUZZ_LEGS = sorted(leg for leg in _GUARD_LEGS if "report" in _GOLDEN[leg])
+_POOL_LEGS = sorted(leg for leg in _GUARD_LEGS if "summary" in _GOLDEN[leg])
+assert sorted(_FUZZ_LEGS + _POOL_LEGS) == sorted(_GUARD_LEGS)
 BUG_CFG = DURABILITY.replace(bug="ack_before_fsync")
 _bug_argv = _GOLDEN["bug"]["argv"]
 BUG_SEED = int(_bug_argv[_bug_argv.index("--seed") + 1])
@@ -182,33 +197,33 @@ def test_violation_name_table_matches_layer_constants():
     assert violation_names(1 << 20) == ["BIT20"]
 
 
-def test_fuzz_report_matches_golden():
+@pytest.mark.parametrize("leg", _FUZZ_LEGS)
+def test_fuzz_report_matches_golden(leg):
     # The hot-path guard: the fixed-seed fuzz REPORT values recorded before
     # this PR must be reproduced bit-identically (tracing/telemetry added
     # zero hot-path cost and no draw-layout change). telemetry (wall times)
     # is the one legitimately nondeterministic key — golden has none.
-    golden = _GOLDEN
-    for leg in ("clean", "bug"):
-        rc, out = run_cli(golden[leg]["argv"])
-        live = out[0]
-        for key, want in golden[leg]["report"].items():
-            assert live[key] == want, (
-                f"{leg}: fuzz report field {key!r} drifted: "
-                f"{live[key]!r} != golden {want!r}"
-            )
+    rc, out = run_cli(_GOLDEN[leg]["argv"])
+    live = out[0]
+    for key, want in _GOLDEN[leg]["report"].items():
+        assert live[key] == want, (
+            f"{leg}: fuzz report field {key!r} drifted: "
+            f"{live[key]!r} != golden {want!r}"
+        )
 
 
-def test_pool_summary_matches_golden():
+@pytest.mark.parametrize("leg", _POOL_LEGS)
+def test_pool_summary_matches_golden(leg):
     # The pool-path extension of the golden guard (PR 6): the fixed-seed
     # pool run's deterministic summary fields must stay bit-identical —
     # proof that the coverage subsystem's separate programs left the
     # coverage-OFF chunk/harvest/refill path (HLO and output) unchanged.
     # Wall-clock keys are excluded by construction (the golden records only
     # deterministic fields).
-    rc, out = run_cli(_GOLDEN["pool"]["argv"])
+    rc, out = run_cli(_GOLDEN[leg]["argv"])
     assert rc == 1, "the planted-bug pool leg must exit 1"
     summary = out[-1]
-    for key, want in _GOLDEN["pool"]["summary"].items():
+    for key, want in _GOLDEN[leg]["summary"].items():
         assert summary[key] == want, (
             f"pool summary field {key!r} drifted: "
             f"{summary[key]!r} != golden {want!r}"

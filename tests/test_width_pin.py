@@ -1,0 +1,328 @@
+"""Static width pins (ISSUE 15): the packed-schema dtype derivations
+asserted against ``packed_bounds`` WITHOUT executing anything.
+
+These tests replace the bench-only re-widening gates that ci.sh used to
+carry (``bytes_per_lane <= 2800/3600``, shardkv ``<= 14000``): those only
+caught a widened field after a full pool/fuzz run, and only when the total
+crossed the ceiling. Here every dtype is checked at import/trace time —
+the minimality tests prove each spec field is the SMALLEST container for
+its ``packed_bounds`` value, the snapshot tests pin the full field->dtype
+tables so any width change (wider or narrower) needs a conscious update
+here, and the byte pins reproduce the exact per-lane totals the old bench
+gates measured, via ``jax.eval_shape`` (shape x itemsize, no device
+allocation, no hot-path execution). The jaxpr-level widen-on-use audit
+(no wide intermediate touching a packed field inside the step) is the
+lint packed_width pass — tpusim/lint.py; this module pins the schema
+side of the same invariant."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.config import packed_bounds, storm_profiles
+from madraft_tpu.tpusim.ctrler import (
+    CtrlerConfig,
+    ctrler_packed_layout,
+    init_ctrler_cluster,
+    pack_ctrler_state,
+)
+from madraft_tpu.tpusim.kv import (
+    _SEQ_LIM,
+    KvConfig,
+    _pack,
+    init_kv_cluster,
+    kv_packed_layout,
+    pack_kv_state,
+)
+from madraft_tpu.tpusim.shardkv import (
+    ShardKvConfig,
+    init_shardkv_cluster,
+    pack_shardkv_state,
+    shardkv_packed_layout,
+)
+from madraft_tpu.tpusim.state import (
+    abstract_bytes,
+    init_cluster,
+    pack_state,
+    packed_spec_for,
+)
+
+# The exact CLI-shaped static configs the ci.sh smokes run (pool on the
+# durability profile; kv/ctrler/shardkv at the fuzz-verb defaults) — the
+# widths below are pinned at the same shapes the old bench gates measured.
+DURABILITY = storm_profiles()["durability"][0]
+KV_CFG = SimConfig().replace(
+    p_client_cmd=0.0, compact_at_commit=False, compact_every=16
+)
+CTRLER_CFG = SimConfig().replace(
+    p_client_cmd=0.0, compact_at_commit=False, log_cap=32, compact_every=8
+)
+SHARDKV_CFG = SimConfig(
+    n_nodes=3, p_client_cmd=0.0, compact_at_commit=False,
+    log_cap=64, compact_every=16, loss_prob=0.05,
+    p_crash=0.0, p_restart=0.2, max_dead=0,
+)
+
+_KEY = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+
+def _min_uint(bound):
+    """Independent re-derivation of state._uint_for: smallest unsigned
+    container for [0, bound]. Deliberately NOT imported from state.py —
+    a re-widening slipped into the production derivation must disagree
+    with this copy."""
+    for dt in (np.uint8, np.uint16, np.uint32):
+        if bound <= np.iinfo(dt).max:
+            return np.dtype(dt)
+    raise AssertionError(f"bound {bound} exceeds u32")
+
+
+def _min_sint(bound):
+    for dt in (np.int8, np.int16, np.int32):
+        if bound <= np.iinfo(dt).max:
+            return np.dtype(dt)
+    raise AssertionError(f"bound {bound} exceeds i32")
+
+
+def _spec_names(sp):
+    return {f: np.dtype(getattr(sp, f)).name
+            for f in sp._fields if f != "noop_code"}
+
+
+def _dts_names(dts):
+    return {f: np.dtype(dt).name for f, dt in dts.items()}
+
+
+# --------------------------------------------------- minimality vs bounds
+def test_raft_spec_is_minimal_for_every_profile():
+    # packed_spec_for must pick the SMALLEST container for each
+    # packed_bounds value — "bump it to be safe" is exactly the silent
+    # re-widening this file exists to catch.
+    cfgs = [SimConfig(), DURABILITY.replace(bug="ack_before_fsync"),
+            KV_CFG, CTRLER_CFG, SHARDKV_CFG]
+    cfgs += [c for legs in storm_profiles().values() for c in legs[:1]]
+    for cfg in cfgs:
+        b = packed_bounds(cfg)
+        sp = packed_spec_for(cfg)
+        assert np.dtype(sp.tick) == _min_uint(b.tick)
+        assert np.dtype(sp.term) == _min_uint(b.term)
+        assert np.dtype(sp.index) == _min_uint(b.index)
+        # + 1: the cmd channel reserves a distinct NOOP sentinel
+        assert np.dtype(sp.cmd) == _min_uint(b.cmd + 1)
+        assert sp.noop_code == np.iinfo(np.dtype(sp.cmd)).max
+        assert np.dtype(sp.tick_signed) == _min_sint(b.tick)
+        assert np.dtype(sp.event) == _min_uint(b.event)
+
+
+def test_service_spec_override_is_minimal_for_documented_bounds():
+    # The kv layer's index/cmd overrides (kv_packed_layout docstring:
+    # submits + leader no-op per node per tick; packed top op) re-derived
+    # here from the same formulas — the spec must be minimal for THEM,
+    # while the non-overridden fields must equal the raft derivation.
+    kcfg = KvConfig()
+    b = packed_bounds(KV_CFG)
+    nc, nk = kcfg.n_clients, kcfg.n_keys
+    idx_bound = (nc + 1) * b.tick + 1
+    cmd_bound = _pack(kcfg, nc - 1, _SEQ_LIM - 1, nk - 1, 3)
+    sp, _ = kv_packed_layout(KV_CFG, kcfg)
+    assert sp == packed_spec_for(KV_CFG, index_bound=idx_bound,
+                                 cmd_bound=cmd_bound)
+    assert np.dtype(sp.index) == _min_uint(idx_bound)
+    assert np.dtype(sp.cmd) == _min_uint(cmd_bound + 1)
+    raft_sp = packed_spec_for(KV_CFG)
+    for f in ("tick", "term", "tick_signed", "event"):
+        assert getattr(sp, f) == getattr(raft_sp, f), (
+            f"kv override changed non-overridden spec field {f!r}"
+        )
+
+
+# ----------------------------------------------------- snapshot dtype pins
+# Full field -> dtype pins at the ci.sh shapes. Any change — widening OR
+# narrowing — must update these literals, which is the point: the old
+# bench ceilings let a field grow silently until the per-lane total
+# crossed 2800/3600/14000; here the diff names the exact field.
+RAFT_SPEC_PIN = {
+    "tick": "uint16", "term": "uint16", "index": "uint16", "cmd": "uint16",
+    "tick_signed": "int16", "event": "uint16",
+}
+KV_SPEC_PIN = dict(RAFT_SPEC_PIN, cmd="uint32")
+KV_DTS_PIN = {
+    "clerk_seq": "uint16", "clerk_out": "bool", "clerk_key": "uint8",
+    "clerk_kind": "uint8", "clerk_acked": "uint16", "clerk_leader": "int8",
+    "clerk_wait": "uint16", "clerk_sub": "uint16", "clerk_app": "uint16",
+    "clerk_cmt": "uint16", "clerk_apl": "uint16", "client_retries": "uint16",
+    "key_lat_hist": "uint16", "client_lat_hist": "uint16",
+    "truth_count": "uint16", "truth_max_seq": "uint16",
+    "clerk_get_lo": "uint16", "clerk_get_obs": "int16",
+    "clerk_last_obs": "int16", "gets_done": "uint16", "applied": "uint16",
+    "last_seq": "uint16", "apply_count": "uint16", "key_hash": "int32",
+    "key_count": "uint16", "snap_last_seq": "uint16",
+    "snap_apply_count": "uint16", "snap_key_hash": "int32",
+    "snap_key_count": "uint16",
+}
+CTRLER_SPEC_PIN = dict(RAFT_SPEC_PIN, cmd="uint32")
+CTRLER_DTS_PIN = {
+    "clerk_seq": "uint16", "clerk_out": "bool", "clerk_arg": "uint8",
+    "clerk_kind": "uint8", "clerk_acked": "uint16", "clerk_q_obs": "int32",
+    "queries_done": "uint16", "clerk_sub": "uint16", "clerk_app": "uint16",
+    "clerk_cmt": "uint16", "clerk_apl": "uint16", "applied": "uint16",
+    "last_seq": "uint16", "member": "bool", "owner": "int8",
+    "cfg_num": "uint8", "hist": "int32", "snap_last_seq": "uint16",
+    "snap_member": "bool", "snap_owner": "int8", "snap_cfg_num": "uint8",
+    "snap_hist": "int32", "w_frontier": "uint16", "w_last_seq": "uint16",
+    "w_member": "bool", "w_owner": "int8", "w_cfg_num": "uint8",
+    "w_hist": "int32", "w_q_seq": "uint16", "w_q_obs": "int32",
+    "w_stalled": "bool",
+}
+SHARDKV_GROUP_SPEC_PIN = dict(RAFT_SPEC_PIN, index="uint32", cmd="uint32")
+SHARDKV_CTRL_SPEC_PIN = dict(RAFT_SPEC_PIN, cmd="uint8")
+SHARDKV_DTS_PIN = {
+    "cfg_owner": "int8", "ctrl_w_frontier": "uint16",
+    "ctrl_w_stalled": "bool", "win_var": "int8", "flip_a": "int8",
+    "flip_b": "int8", "slot_tick": "int16", "cmem": "bool",
+    "ctrl_node_owner": "int8", "ctrl_maps": "int8", "node_src": "int8",
+    "snap_src": "int8", "w_src": "int8", "cq_req_node": "int8",
+    "cq_req_j": "uint8", "cq_rsp_j": "uint8", "cq_rsp_found": "bool",
+    "cq_rsp_var": "uint8", "applied": "uint32", "node_cfg": "uint8",
+    "phase": "uint8", "key_hash": "int32", "key_count": "uint16",
+    "last_seq": "uint16", "snap_cfg": "uint8", "snap_phase": "uint8",
+    "snap_hash": "int32", "snap_count": "uint16", "snap_last_seq": "uint16",
+    "staged_cfg": "int8", "staged_hash": "int32", "staged_count": "uint16",
+    "staged_last_seq": "uint16", "pull_req_cfg": "uint8",
+    "pull_rsp_cfg": "uint8", "pull_rsp_hash": "int32",
+    "pull_rsp_count": "uint16", "pull_rsp_last_seq": "uint16",
+    "gcq_req_cfg": "uint8", "gcq_rsp_cfg": "uint8", "clerk_seq": "uint16",
+    "clerk_out": "bool", "clerk_shard": "uint8", "clerk_kind": "uint8",
+    "clerk_cfg": "uint8", "clerk_wrong": "bool", "clerk_acked": "uint16",
+    "clerk_get_lo": "uint16", "clerk_get_obs": "int16",
+    "gets_done": "uint16", "clerk_sub": "uint16", "lat_hist": "uint16",
+    "clerk_app": "uint16", "clerk_cmt": "uint16", "clerk_apl": "uint16",
+    "clerk_mig": "uint16", "client_retries": "uint16",
+    "phase_hist": "uint16", "phase_ticks": "int32", "lat_ticks": "int32",
+    "worst_lat": "uint16", "worst_phases": "uint16", "worst_key": "int32",
+    "worst_client": "int32", "worst_sub": "uint16",
+    "key_lat_hist": "uint16", "client_lat_hist": "uint16",
+    "w_frontier": "uint32", "w_cfg": "uint8", "w_phase": "uint8",
+    "w_hash": "int32", "w_count": "uint16", "w_last_seq": "uint16",
+    "frz_cfg": "int8", "frz_hash": "int32", "frz_count": "uint16",
+    "frz_last_seq": "uint16", "truth_count": "uint16",
+    "w_clerk_acked": "uint16", "installs_done": "int32",
+    "deletes_done": "int32", "max_cfg_lag": "uint8", "violations": "int32",
+    "first_violation_tick": "int16",
+}
+
+
+def test_raft_spec_pinned_at_pool_shape():
+    assert _spec_names(packed_spec_for(DURABILITY)) == RAFT_SPEC_PIN
+
+
+def test_kv_layout_pinned():
+    sp, dts = kv_packed_layout(KV_CFG, KvConfig())
+    assert _spec_names(sp) == KV_SPEC_PIN
+    assert _dts_names(dts) == KV_DTS_PIN
+
+
+def test_ctrler_layout_pinned():
+    sp, dts = ctrler_packed_layout(CTRLER_CFG, CtrlerConfig())
+    assert _spec_names(sp) == CTRLER_SPEC_PIN
+    assert _dts_names(dts) == CTRLER_DTS_PIN
+
+
+def test_shardkv_layout_pinned():
+    sp, csp, dts = shardkv_packed_layout(SHARDKV_CFG, ShardKvConfig())
+    assert _spec_names(sp) == SHARDKV_GROUP_SPEC_PIN
+    assert _spec_names(csp) == SHARDKV_CTRL_SPEC_PIN
+    assert _dts_names(dts) == SHARDKV_DTS_PIN
+
+
+def test_no_packed_field_reaches_wide_width_unpinned():
+    # The direct re-widening guard: a 4-byte field in any layout table
+    # must already be pinned as int32/uint32 above (full-width-by-design
+    # hashes / latency sums / sentinel ids). A new wide field fails here
+    # with its name, not as an opaque byte-total regression.
+    for pin, dts in (
+        (KV_DTS_PIN, kv_packed_layout(KV_CFG, KvConfig())[1]),
+        (CTRLER_DTS_PIN, ctrler_packed_layout(CTRLER_CFG, CtrlerConfig())[1]),
+        (SHARDKV_DTS_PIN, shardkv_packed_layout(SHARDKV_CFG,
+                                                ShardKvConfig())[2]),
+    ):
+        for f, dt in dts.items():
+            if np.dtype(dt).itemsize >= 4:
+                assert pin[f] in ("int32", "uint32"), (
+                    f"field {f!r} widened to {np.dtype(dt).name} without a "
+                    "pin update"
+                )
+
+
+# --------------------------------------------- packed <= wide, per field
+def _packed_vs_wide(cfg):
+    wide = jax.eval_shape(lambda k: init_cluster(cfg, k), _KEY)
+    packed = jax.eval_shape(lambda k: pack_state(cfg, init_cluster(cfg, k)),
+                            _KEY)
+    return wide, packed
+
+
+def test_packed_raft_state_never_wider_than_wide():
+    # Field-for-field: the packed carry may never cost more bytes than the
+    # wide carry it replaces (bitfield words may change SHAPE — role_bits
+    # packs an [n] row into a scalar — so compare total bytes per field).
+    for cfg in (DURABILITY, DURABILITY.replace(metrics=True)):
+        wide, packed = _packed_vs_wide(cfg)
+        for f in wide._fields:
+            if not hasattr(packed, f):
+                continue
+            wb = int(np.prod(getattr(wide, f).shape)) * np.dtype(
+                getattr(wide, f).dtype).itemsize
+            pb = int(np.prod(getattr(packed, f).shape)) * np.dtype(
+                getattr(packed, f).dtype).itemsize
+            assert pb <= wb, (
+                f"packed field {f!r} costs {pb} B > wide {wb} B"
+            )
+
+
+# ------------------------------------------------------ static byte pins
+# Exact totals via eval_shape at the ci.sh smoke shapes — the numbers the
+# old executed gates measured (PERF.md rounds 9/11/12), now proven without
+# running a tick. The <= ceilings are kept as the documented regression
+# budget; the == pins are what actually catch a one-field widening.
+def test_static_bytes_per_lane_pool_shape():
+    cfg = DURABILITY.replace(bug="ack_before_fsync")
+    got = abstract_bytes(jax.eval_shape(
+        lambda k: pack_state(cfg, init_cluster(cfg, k)), _KEY))
+    assert got == 2597, f"packed raft carry drifted: {got} B/lane != 2597"
+    assert got <= 2800  # the retired ci.sh BYTES_PER_LANE_BOUND
+
+
+def test_static_bytes_per_lane_metrics_shape():
+    cfg = DURABILITY.replace(bug="ack_before_fsync", metrics=True)
+    got = abstract_bytes(jax.eval_shape(
+        lambda k: pack_state(cfg, init_cluster(cfg, k)), _KEY))
+    assert got == 3585, f"metrics-on packed carry drifted: {got} != 3585"
+    assert got <= 3600  # the retired METRICS_BYTES_PER_LANE_BOUND
+
+
+def test_static_bytes_per_deployment_shardkv_shape():
+    kcfg = ShardKvConfig()
+    got = abstract_bytes(jax.eval_shape(
+        lambda k: pack_shardkv_state(
+            SHARDKV_CFG, kcfg,
+            init_shardkv_cluster(SHARDKV_CFG, kcfg, k)), _KEY))
+    assert got == 12840, f"packed shardkv carry drifted: {got} != 12840"
+    assert got <= 14000  # the retired SHARDKV_BYTES_PER_DEPLOYMENT_BOUND
+
+
+def test_static_bytes_service_lanes():
+    # kv/ctrler analogues (no old ceiling existed; pin the totals so the
+    # service carries get the same one-field sensitivity)
+    kcfg = KvConfig()
+    got = abstract_bytes(jax.eval_shape(
+        lambda k: pack_kv_state(KV_CFG, kcfg,
+                                init_kv_cluster(KV_CFG, kcfg, k)), _KEY))
+    assert got == 3863, f"packed kv carry drifted: {got} != 3863"
+    ccfg = CtrlerConfig()
+    got = abstract_bytes(jax.eval_shape(
+        lambda k: pack_ctrler_state(
+            CTRLER_CFG, ccfg,
+            init_ctrler_cluster(CTRLER_CFG, ccfg, k)), _KEY))
+    assert got == 3622, f"packed ctrler carry drifted: {got} != 3622"
