@@ -20,6 +20,22 @@ import subprocess
 import sys
 import time
 
+# Load telemetry.py by file path, NOT through the madraft_tpu package
+# (whose __init__ imports the JAX stack): this tool must keep running on
+# a box with no JAX at all, and telemetry.py itself is stdlib-only at
+# module scope by contract.
+import importlib.util as _ilu
+
+_spec = _ilu.spec_from_file_location(
+    "_madtpu_telemetry",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "madraft_tpu", "tpusim", "telemetry.py"),
+)
+_telemetry = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_telemetry)
+HeartbeatWriter = _telemetry.HeartbeatWriter
+digest_line = _telemetry.digest_line
+
 
 def main() -> None:
     n_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 50
@@ -28,6 +44,22 @@ def main() -> None:
     binary = os.path.join(here, "build", "madtpu_tests")
     if not os.path.exists(binary):
         sys.exit(f"build first: cmake -S cpp -B build -G Ninja && ninja -C build")
+
+    # progress rides the heartbeat stream + manifest (ISSUE 17), same as
+    # the TPU soak: one row per seed, stderr digest every 10th, and a
+    # watcher can tell crashed from running from done via the manifest
+    soak_out = os.environ.get("SOAK_OUT")
+
+    def on_row(row):
+        if row["gen"] % 10 == 0:
+            print(f"# cpp_seeds: {digest_line(row)}", file=sys.stderr,
+                  flush=True)
+
+    hb = HeartbeatWriter(
+        soak_out + ".heartbeat.jsonl" if soak_out else None, on_row=on_row
+    )
+    hb.open({"kind": "cpp_soak", "n_seeds": n_seeds,
+             "seed_base": seed_base, "out": soak_out})
 
     t0 = time.time()
     failed = []
@@ -83,12 +115,13 @@ def main() -> None:
             print(json.dumps(failed[-1]), flush=True)
         else:
             tests_per_seed = max(tests_per_seed, oks // 2)
-        if (i + 1) % 10 == 0:
-            print(
-                f"# {i + 1}/{n_seeds} seeds, {len(failed)} failed, "
-                f"{time.time() - t0:.0f}s",
-                file=sys.stderr, flush=True,
-            )
+        w = time.time() - t0
+        hb.row(
+            {"seed": seed, "seeds_run": i + 1, "n_seeds": n_seeds,
+             "failed": len(failed)},
+            {"wall_s": round(w, 1),
+             "budget_frac": round((i + 1) / n_seeds, 4)},
+        )
 
     out = {
         "metric": "cpp_suite_seed_soak",
@@ -104,6 +137,7 @@ def main() -> None:
     if path:
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
+    hb.close("done")  # a failing seed still ran to completion
     print(json.dumps(out), flush=True)
     sys.exit(1 if failed else 0)
 

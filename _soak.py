@@ -38,11 +38,24 @@ from madraft_tpu.tpusim.shardkv import (
     make_shardkv_fuzz_fn,
     shardkv_report,
 )
+from madraft_tpu.tpusim.telemetry import (
+    HeartbeatWriter,
+    digest_line,
+    manifest_path,
+    write_json_atomic,
+)
 
 # set by main(); module-level defaults keep `import _soak` (e.g. from
 # _campaign.py, for the shared grid) argument-free
 SCALE = 1.0
 SEED_BASE = 0  # added to every region's seed0: re-runs cover fresh universes
+
+# Live telemetry (ISSUE 17): main() rebinds this to a file-backed writer
+# when SOAK_OUT is set (<SOAK_OUT>.heartbeat.jsonl + the attachable
+# manifest, so `stats --follow` can watch a multi-day soak and a dead run
+# reads as "crashed" instead of silence). The default pathless writer
+# keeps `import _soak` and bare `drive()` calls file-free.
+HEARTBEAT = HeartbeatWriter()
 
 
 def flagship() -> SimConfig:
@@ -95,15 +108,19 @@ def grid_knobs(cfg: SimConfig, n: int):
 def _checkpoint_partial(rows) -> None:
     """After each region, persist what has run so far: two tunnel outages
     this round killed soaks mid-run and left NO artifact for ~1e10 clean
-    steps. Written atomically (tmp + rename) so the abrupt kill this exists
-    to survive cannot half-write it; replaced by the final artifact on
-    success."""
+    steps. Written atomically (telemetry.write_json_atomic — the one copy
+    of the tmp+replace rule) so the abrupt kill this exists to survive
+    cannot half-write it; replaced by the final artifact on success. The
+    checkpoint references the run manifest and its last-generation pointer,
+    so a recovery tool can line the partial up against the heartbeat
+    stream's finer-grained per-rep rows."""
     path = os.environ.get("SOAK_OUT")
     if path:
-        tmp = path + ".partial.tmp"
-        with open(tmp, "w") as f:
-            json.dump({"regions": rows, "complete": False}, f, indent=1)
-        os.replace(tmp, path + ".partial")
+        doc = {"regions": rows, "complete": False}
+        if HEARTBEAT.path:
+            doc["heartbeat_manifest"] = manifest_path(HEARTBEAT.path)
+            doc["last_gen"] = HEARTBEAT.gen - 1 if HEARTBEAT.gen else None
+        write_json_atomic(path + ".partial", doc)
 
 
 def drive(name, fn, steps_per_rep, target_steps, stats, seed0):
@@ -127,6 +144,19 @@ def drive(name, fn, steps_per_rep, target_steps, stats, seed0):
         if (v != 0).any():
             bad.append({"seed": seed0 + r, "clusters": np.nonzero(v != 0)[0][:8].tolist()})
         live += int(l)
+        # one heartbeat row per rep (ISSUE 17): the soak's per-rep progress
+        # rides the same stream/manifest as the pool's per-generation rows,
+        # so `stats --follow` and the watcher tri-state work unchanged
+        w = time.perf_counter() - t0
+        HEARTBEAT.row(
+            {"region": name, "rep": r + 1, "reps": reps,
+             "cluster_steps": (r + 1) * steps_per_rep,
+             "violating": viol, "live": live},
+            {"wall_s": round(w, 3),
+             "steps_per_s": round((r + 1) * steps_per_rep / w, 1)
+             if w > 0 else None,
+             "budget_frac": round((r + 1) / reps, 4)},
+        )
     wall = time.perf_counter() - t0
     row = {
         "region": name,
@@ -165,6 +195,24 @@ def main() -> None:
     dev = str(jax.devices()[0])
     t_start = time.time()
     rows = []
+
+    global HEARTBEAT
+    soak_out = os.environ.get("SOAK_OUT")
+
+    def on_row(row):
+        # low-cadence human progress on stderr (every 10th rep), derived
+        # from the same heartbeat rows the file stream carries — no second
+        # progress bookkeeping path
+        if row["gen"] % 10 == 0:
+            det = row.get("det", {})
+            print(f"# soak {det.get('region')}: {digest_line(row)}",
+                  file=sys.stderr, flush=True)
+
+    HEARTBEAT = HeartbeatWriter(
+        soak_out + ".heartbeat.jsonl" if soak_out else None, on_row=on_row
+    )
+    HEARTBEAT.open({"kind": "soak", "scale": SCALE, "seed_base": SEED_BASE,
+                    "device": dev, "out": soak_out})
 
     def run_region(*a, **kw):
         rows.append(drive(*a, **kw))
@@ -289,6 +337,10 @@ def main() -> None:
         partial = path + ".partial"
         if os.path.exists(partial):
             os.unlink(partial)
+    # terminal manifest: watchers see "done" (a violating soak still RAN to
+    # completion — the artifact carries the verdict; an abrupt kill instead
+    # reads as "crashed": running status with a dead pid)
+    HEARTBEAT.close("done")
     print(json.dumps(out), flush=True)
     sys.exit(1 if viol else 0)
 

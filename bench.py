@@ -40,6 +40,7 @@ Methodology (round-3; see PERF.md for the batch-size sweep and phase budget):
 """
 
 import json
+import os
 import sys
 import time
 
@@ -325,6 +326,70 @@ def bench_pool(n_lanes: int, budget_ticks: int) -> dict:
         "viol_per_chip_s_ratio": (
             round(pool_vps / fuzz_vps, 3) if fuzz_vps else None
         ),
+    }
+
+
+# Pinned bound for the telemetry-overhead A/B: heartbeat-on wall within
+# 25% of heartbeat-off at equal shape. The emission runs on the harvest-
+# consumer thread (hidden in host_overlap_s under the next chunk's device
+# execution), so the true cost is ~0; the slack is single-run pool noise
+# (PERF.md run-spread caveat), not an emission budget.
+TELEMETRY_OVERHEAD_BOUND = 1.25
+
+
+def bench_telemetry_overhead(n_lanes: int, budget_ticks: int) -> dict:
+    """Heartbeat-emission overhead A/B (ISSUE 17): the SAME pool run with
+    the live-telemetry plane off vs on (--heartbeat to a scratch file).
+    Pins two claims: the deterministic counters are bit-identical (the
+    plane only observes), and throughput stays within
+    TELEMETRY_OVERHEAD_BOUND of heartbeat-off — i.e. per-generation row
+    emission stays hidden in host_overlap_s instead of stretching the
+    device loop."""
+    import tempfile
+
+    from madraft_tpu.tpusim.config import storm_profiles
+    from madraft_tpu.tpusim.engine import default_chunk_ticks
+
+    prof, _, rec_ticks, _bugs = storm_profiles()["durability"]
+    cfg = prof.replace(bug="ack_before_fsync")
+    horizon = min(rec_ticks, budget_ticks)
+    chunk = default_chunk_ticks(horizon)
+
+    off = run_pool(cfg, 12345, n_lanes, horizon,
+                   chunk_ticks=chunk, budget_ticks=budget_ticks)
+    with tempfile.TemporaryDirectory() as d:
+        hb_path = os.path.join(d, "bench_hb.jsonl")
+        on = run_pool(cfg, 12345, n_lanes, horizon,
+                      chunk_ticks=chunk, budget_ticks=budget_ticks,
+                      heartbeat=hb_path)
+        with open(hb_path) as f:
+            hb_rows = sum(1 for line in f if line.strip())
+    det_identical = all(
+        off[k] == on[k]
+        for k in ("retired", "retired_violating", "effective_cluster_steps",
+                  "lane_ticks")
+    )
+    wall_ratio = (on["wall_s"] / off["wall_s"]) if off["wall_s"] else None
+    return {
+        "profile": "durability",
+        "bug": "ack_before_fsync",
+        "lanes": n_lanes,
+        "budget_ticks": budget_ticks,
+        "heartbeat_rows": hb_rows,
+        "off_steps_per_sec": off["steps_per_sec"],
+        "on_steps_per_sec": on["steps_per_sec"],
+        "off_wall_s": off["wall_s"],
+        "on_wall_s": on["wall_s"],
+        # where the emission wall actually went: consumer-thread overlap,
+        # not the device loop (gap would grow if emission out-ran chunks)
+        "off_host_overlap_s": off["host_overlap_s"],
+        "on_host_overlap_s": on["host_overlap_s"],
+        "on_dispatch_gap_s": on["dispatch_gap_s"],
+        "det_columns_identical": det_identical,
+        "wall_ratio": round(wall_ratio, 3) if wall_ratio else None,
+        "bound": TELEMETRY_OVERHEAD_BOUND,
+        "pass": bool(det_identical and wall_ratio is not None
+                     and wall_ratio <= TELEMETRY_OVERHEAD_BOUND),
     }
 
 
@@ -897,6 +962,10 @@ def main() -> None:
     # horizons makes it first-order (PERF.md round 6); smokes keep a small
     # budget so the row stays cheap on CPU
     pool = bench_pool(max(64, n_clusters // 16), max(2400, 12 * n_ticks))
+    # live-telemetry overhead A/B (ISSUE 17): heartbeat-off vs -on at equal
+    # shape; smaller budget than the pool row — it pays two full pool runs
+    telem = bench_telemetry_overhead(max(64, n_clusters // 16),
+                                     max(1200, 6 * n_ticks))
     # sharded-pool 1-vs-2-device scaling A/B (ROADMAP item 1), in its own
     # 2-virtual-device subprocess; smaller budget than the pool row — it
     # pays two full pool runs
@@ -952,6 +1021,9 @@ def main() -> None:
                         "viol_per_chip_s_ratio"
                     ],
                     "pool": pool,
+                    # heartbeat-emission overhead gate (ISSUE 17)
+                    "telemetry_overhead_pass": telem["pass"],
+                    "telemetry_overhead": telem,
                     "pool_scaling_efficiency": pscale.get(
                         "scaling_efficiency"
                     ),

@@ -252,6 +252,59 @@ print(f"metrics smoke: bug leg {lat['ops']} ops "
       f"{DURABILITY_P99_BOUND}")
 PY
 
+# heartbeat smoke (ISSUE 17): the live-telemetry plane through the pool
+# CLI. The planted-bug leg streams one JSONL row per harvest generation to
+# --heartbeat; the final row's deterministic columns must reconcile EXACTLY
+# with the pool summary (same retire accounting, observed not recomputed),
+# the sibling manifest must land terminal status "done", and `stats` must
+# render the live stream. The clean leg pins that the plane never perturbs
+# the exit-code convention (0 = no violation). Telemetry is host-side only
+# — the lint registry's cached-program pin (tests/test_lint.py, exactly 31)
+# is the static proof the hot path gained zero new compiled programs.
+MADTPU_PLATFORM=cpu python - <<'PY'
+import contextlib, io, json, os, tempfile
+from madraft_tpu.__main__ import main
+from madraft_tpu.tpusim.telemetry import manifest_path, manifest_status
+
+d = tempfile.mkdtemp()
+hb = os.path.join(d, "ci_hb.jsonl")
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["pool", "--profile", "durability", "--bug", "ack_before_fsync",
+               "--clusters", "64", "--ticks", "300", "--chunk-ticks", "100",
+               "--budget-ticks", "600", "--seed", "1", "--heartbeat", hb])
+summary = json.loads(buf.getvalue().strip().splitlines()[-1])
+assert rc == 1, f"heartbeat bug leg exit {rc} != 1"
+with open(hb) as f:
+    rows = [json.loads(x) for x in f if x.strip()]
+assert rows and rows[-1].get("final"), rows[-1:]
+fin = rows[-1]["det"]
+assert fin["retired"] == summary["retired"], (fin, summary["retired"])
+assert fin["violating"] == summary["retired_violating"]
+assert fin["effective_steps"] == summary["effective_cluster_steps"]
+assert rows[-1]["lane_ticks"] == summary["lane_ticks"]
+man = json.load(open(manifest_path(hb)))
+assert manifest_status(man) == "done" and man["last_gen"] == rows[-1]["gen"]
+sbuf = io.StringIO()
+with contextlib.redirect_stdout(sbuf):
+    src = main(["stats", hb])
+assert src == 0 and "final" in sbuf.getvalue(), \
+    "stats verb failed to render the heartbeat stream"
+
+hb2 = os.path.join(d, "ci_hb_clean.jsonl")
+buf = io.StringIO()
+with contextlib.redirect_stdout(buf):
+    rc = main(["pool", "--profile", "durability", "--clusters", "64",
+               "--ticks", "300", "--chunk-ticks", "100",
+               "--budget-ticks", "300", "--seed", "12345",
+               "--heartbeat", hb2])
+assert rc == 0, f"heartbeat clean leg exit {rc} != 0"
+assert manifest_status(json.load(open(manifest_path(hb2)))) == "done"
+print(f"heartbeat smoke: {len(rows)} rows, final gen {rows[-1]['gen']} "
+      f"reconciles with summary (retired {fin['retired']}, "
+      f"{fin['violating']} violating), manifest done")
+PY
+
 # service packed-state smoke (ISSUE 11): the kv/ctrler/shardkv fuzz verbs
 # carry their loop state in the packed SERVICE schemas at the default
 # shapes — each leg must report state_layout "packed" in its telemetry,

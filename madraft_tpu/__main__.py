@@ -364,11 +364,29 @@ def cmd_pool(args):
         if emit_all or row["violations"]:
             print(json.dumps(row), flush=True)
 
+    # live-telemetry plane (ISSUE 17): --heartbeat streams one JSONL row
+    # per harvest generation (+ the attachable manifest) to PATH;
+    # --digest-every N prints the one-line human digest of every Nth
+    # generation on stderr — stdout stays a clean JSONL stream either way
+    hb = None
+    if args.digest_every < 0:
+        usage_error(f"--digest-every {args.digest_every} must be >= 1 "
+                    f"(0 = off)")
+    if args.heartbeat or args.digest_every:
+        from madraft_tpu.tpusim.telemetry import HeartbeatWriter, digest_line
+
+        def on_row(row, _every=args.digest_every):
+            if _every and not row.get("final") and row["gen"] % _every == 0:
+                print(f"pool: {digest_line(row)}", file=sys.stderr,
+                      flush=True)
+
+        hb = HeartbeatWriter(args.heartbeat or None, on_row=on_row)
+
     summary = run_pool(
         cfg, args.seed, args.clusters, args.ticks,
         chunk_ticks=args.chunk_ticks, budget_ticks=budget_ticks,
         budget_seconds=budget_seconds, devices=devices,
-        on_retired=on_retired, coverage=ccfg,
+        on_retired=on_retired, coverage=ccfg, heartbeat=hb,
     )
     dev = jax.devices()[0]
     summary.update(
@@ -633,11 +651,62 @@ def cmd_replay(args):
     return 1 if int(st.violations) else 0
 
 
+def _explain_heartbeat(args) -> int:
+    """`explain --heartbeat` (ISSUE 17): render a pool heartbeat stream as
+    a Perfetto host timeline — per-generation chunk/harvest/emit spans plus
+    counter tracks — instead of replaying a cluster. Pure host-side (no
+    backend, no compiled programs), same --out/--format conventions as the
+    cluster mode."""
+    from madraft_tpu.tpusim.telemetry import read_heartbeat, read_manifest
+    from madraft_tpu.tpusim.trace import chrome_pool_timeline
+
+    def usage_error(msg):
+        print(f"explain: {msg}", file=sys.stderr)
+        raise SystemExit(2)
+
+    if args.format != "chrome":
+        usage_error("--heartbeat renders a host timeline: add "
+                    "--format chrome")
+    try:
+        with open(args.heartbeat) as f:
+            rows = read_heartbeat(f)
+    except OSError as e:
+        usage_error(str(e))
+    if not rows:
+        usage_error(f"no heartbeat rows in {args.heartbeat}")
+    manifest = read_manifest(args.heartbeat)
+    doc = chrome_pool_timeline(
+        rows, label=f"madtpu pool heartbeat {args.heartbeat}",
+        manifest=manifest,
+    )
+    text = json.dumps(doc)
+    header = {
+        "heartbeat": args.heartbeat,
+        "generations": len(rows),
+        "trace_events": len(doc["traceEvents"]),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        header["trace_file"] = args.out
+        print(json.dumps(header))
+    else:
+        print(text)
+    return 0
+
+
 def cmd_explain(args):
     """Flight-recorder replay of ONE cluster: decode the per-tick trace into
     a structured event timeline (JSONL around the first violation) or a
-    Perfetto-loadable chrome trace. A debugging tool, not a checker: exit 0
-    whenever the replay ran, violations or not."""
+    Perfetto-loadable chrome trace; or, with --heartbeat, a host-timeline
+    render of a pool's telemetry stream. A debugging tool, not a checker:
+    exit 0 whenever the replay ran, violations or not."""
+    if args.heartbeat:
+        return _explain_heartbeat(args)
+    if args.cluster is None:
+        print("explain: --cluster is required (or --heartbeat PATH for "
+              "the pool host timeline)", file=sys.stderr)
+        raise SystemExit(2)
     from madraft_tpu.tpusim.config import violation_names
     from madraft_tpu.tpusim.trace import (
         chrome_trace,
@@ -706,6 +775,10 @@ class _StatsMerge:
         self.by_key: dict = {}    # key -> hist row
         self.by_client: dict = {}  # client -> hist row
         self.worst = None
+        # last heartbeat row per stream (ISSUE 17; None for non-heartbeat
+        # streams) — the live-pool progress block of the render
+        self.live_per_stream: list = []
+        self.paths: list = []
 
 
 def _merge_axis(table: dict, key, hist_row) -> None:
@@ -757,7 +830,30 @@ def _collect_stats(streams) -> _StatsMerge:
             for d in docs
         )
         stream_seen = 0
+        last_hb = None
         for doc in docs:
+            if doc.get("hb") == 1:
+                # heartbeat row (ISSUE 17): window histograms sum across a
+                # stream's rows to exactly the run-cumulative histogram
+                # (fixed buckets, pure addition), so merging every hist_w
+                # here equals merging the finished summary. Window phase
+                # ticks merge by name; windows carry no per-phase
+                # histograms, so those columns stay zero-hist like
+                # rows-only pool inputs carry ticks_total 0.
+                last_hb = doc
+                det = doc.get("det") or {}
+                hlat = det.get("latency")
+                m.seen += 1
+                stream_seen += 1
+                if isinstance(hlat, dict) and hlat.get("hist_w") and \
+                        len(hlat["hist_w"]) == HIST_BUCKETS:
+                    m.hist += np.asarray(hlat["hist_w"], np.int64)
+                    for name, t in (hlat.get("phase_ticks_w") or {}).items():
+                        old_h, old_t = m.phases.get(
+                            name, (np.zeros(HIST_BUCKETS, np.int64), 0)
+                        )
+                        m.phases[name] = (old_h, old_t + int(t))
+                continue
             lat = doc.get("latency")
             row_hist = None
             row_phases = None
@@ -824,17 +920,14 @@ def _collect_stats(streams) -> _StatsMerge:
                 for i, name in enumerate(METRIC_EVENTS):
                     m.events[i] += int(row_ev.get(name, 0))
         m.seen_per_stream.append(stream_seen)
+        m.live_per_stream.append(last_hb)
     return m
 
 
-def cmd_stats(args):
-    """Render the metrics plane of any report artifact (ISSUE 10): feed it
-    a fuzz/sweep report, a pool summary + JSONL stream, or any mix of
-    files; it merges every histogram/counter row it finds (fixed buckets
-    sum across sources) and prints the latency distribution, p50/p99, and
-    the liveness-counter table. A read-only renderer: exit 0 when metrics
-    were found, exit 2 when the input carries none (e.g. a metrics-off
-    report — say so rather than print an empty table)."""
+def _stats_once(args, paths) -> int:
+    """One read-merge-render pass over ``paths`` (the whole historic
+    `stats` body; `--follow` re-runs it per poll, which is what makes the
+    final followed render EQUAL to the one-shot render by construction)."""
     from madraft_tpu.tpusim.config import METRIC_EVENTS
     from madraft_tpu.tpusim.metrics import (
         latency_summary,
@@ -842,7 +935,6 @@ def cmd_stats(args):
     )
 
     streams = []
-    paths = args.inputs or ["-"]
     for path in paths:
         if path == "-":
             streams.append(sys.stdin.read().splitlines())
@@ -854,6 +946,7 @@ def cmd_stats(args):
                 print(f"stats: {e}", file=sys.stderr)
                 raise SystemExit(2)
     m = _collect_stats(streams)
+    m.paths = list(paths)
     empty = [p for p, n in zip(paths, m.seen_per_stream) if n == 0]
     if not m.seen:
         # name the specific inputs so a glob with one stale metrics-off
@@ -874,6 +967,63 @@ def cmd_stats(args):
     except BrokenPipeError:  # e.g. `stats ... | head` — not an error
         pass
     return 0
+
+
+def _follow_stats(args, paths):
+    """`stats --follow` (ISSUE 17): poll the run manifests next to the
+    inputs and re-render in place until every run is terminal. Returns the
+    final render's exit code, or None to degrade to one-shot — inputs with
+    no live manifest (finished artifacts, plain report files, stdin) get
+    exactly the historic render, which is also what makes the followed
+    final render of a finished run provably equal to one-shot `stats`."""
+    import time as time_mod
+
+    from madraft_tpu.tpusim.telemetry import (
+        is_terminal,
+        manifest_status,
+        read_manifest,
+    )
+
+    real = [p for p in paths if p != "-"]
+    mans = {p: read_manifest(p) for p in real}
+    live = [p for p, d in mans.items()
+            if d is not None and not is_terminal(manifest_status(d))]
+    if not live:
+        if not any(d is not None for d in mans.values()):
+            print("stats: no run manifest next to the inputs — one-shot "
+                  "render", file=sys.stderr)
+        return None
+    while True:
+        statuses = {p: manifest_status(read_manifest(p)) for p in real}
+        still_live = [p for p in live
+                      if not is_terminal(statuses.get(p, "unknown"))]
+        if sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home: in-place
+        rc = _stats_once(args, paths)
+        for p in live:
+            print(f"stats: {p}: {statuses.get(p, 'unknown')}",
+                  file=sys.stderr)
+        if not still_live:
+            return rc
+        time_mod.sleep(args.interval)
+
+
+def cmd_stats(args):
+    """Render the metrics plane of any report artifact (ISSUE 10): feed it
+    a fuzz/sweep report, a pool summary + JSONL stream, a live heartbeat
+    stream (ISSUE 17), or any mix of files; it merges every
+    histogram/counter row it finds (fixed buckets sum across sources) and
+    prints the latency distribution, p50/p99, and the liveness-counter
+    table. A read-only renderer: exit 0 when metrics were found, exit 2
+    when the input carries none (e.g. a metrics-off report — say so rather
+    than print an empty table). --follow tails live heartbeat inputs via
+    their manifests and re-renders until the runs finish."""
+    paths = args.inputs or ["-"]
+    if getattr(args, "follow", False):
+        rc = _follow_stats(args, paths)
+        if rc is not None:
+            return rc
+    return _stats_once(args, paths)
 
 
 def cmd_lint(args):
@@ -936,6 +1086,27 @@ def _print_stats(args, m, lat, METRIC_EVENTS, render_histogram):
     from madraft_tpu.tpusim.metrics import latency_summary
 
     print(f"sources merged: {m.seen}")
+    for p, hbr in zip(m.paths, m.live_per_stream):
+        if hbr is None:
+            continue
+        # heartbeat progress block (ISSUE 17): the stream's newest row —
+        # deterministic counters first, then the explicitly wall-clock part
+        det, t = hbr.get("det", {}), hbr.get("t", {})
+        bits = [f"gen {hbr.get('gen')}"]
+        if hbr.get("lane_ticks") is not None:
+            bits.append(f"lane_ticks {hbr['lane_ticks']}")
+        if det.get("retired") is not None:
+            bits.append(f"retired {det['retired']} "
+                        f"({det.get('violating', 0)} violating)")
+        if det.get("new_fps") is not None:
+            bits.append(f"fingerprints {det['new_fps']}")
+        if t.get("budget_frac") is not None:
+            bits.append(f"{100.0 * t['budget_frac']:.0f}% of budget")
+        if t.get("wall_s") is not None:
+            bits.append(f"wall {t['wall_s']}s")
+        state = "final" if hbr.get("final") else "live"
+        name = "stdin" if p == "-" else p
+        print(f"pool {name} [{state}]: " + " · ".join(bits))
     print(f"latency: ops={lat['ops']} p50={lat['p50_ticks']} "
           f"p99={lat['p99_ticks']} (ticks; log-spaced buckets, quantile = "
           f"bucket upper edge)")
@@ -1163,6 +1334,18 @@ def main(argv=None) -> int:
                     help="with --coverage: count coverage but refill "
                          "uniformly at the base knobs (measurement-only "
                          "mode — the random baseline of the A/B)")
+    sp.add_argument("--heartbeat", default="",
+                    help="live-telemetry stream (README 'Live telemetry'): "
+                         "write one JSONL row per harvest generation to "
+                         "PATH (deterministic counters + timing columns) "
+                         "and keep PATH.manifest.json atomically updated "
+                         "so a watcher can attach (`stats --follow PATH`) "
+                         "and tell crashed from running from done")
+    sp.add_argument("--digest-every", type=int, default=0,
+                    help="print a one-line progress digest of every Nth "
+                         "harvest generation on stderr (gen/budget%%/"
+                         "viol-per-s/p99); stdout stays clean JSONL "
+                         "(0 = off)")
     sp.set_defaults(fn=cmd_pool)
 
     sp = sub.add_parser("kv-fuzz", help="KV service fuzz (Lab 3)")
@@ -1226,10 +1409,20 @@ def main(argv=None) -> int:
     sp = sub.add_parser(
         "explain",
         help="flight-recorder replay of ONE cluster: decoded event timeline "
-             "(JSONL) around the first violation, or a Perfetto export",
+             "(JSONL) around the first violation, or a Perfetto export; "
+             "with --heartbeat, a Perfetto host timeline of a pool run",
     )
     common(sp, 1)
-    sp.add_argument("--cluster", type=int, required=True)
+    sp.add_argument("--cluster", type=int, default=None,
+                    help="cluster id to replay (required unless "
+                         "--heartbeat)")
+    sp.add_argument("--heartbeat", default="",
+                    help="render a pool heartbeat stream (pool --heartbeat "
+                         "PATH) as a Perfetto host timeline instead of "
+                         "replaying a cluster: chunk/harvest/emit spans "
+                         "per generation + counter tracks (violations/s, "
+                         "coverage, p99, device_wait); needs --format "
+                         "chrome; runs with no accelerator")
     sp.add_argument("--window", type=int, default=60,
                     help="±ticks around first_violation_tick to print "
                          "(<= 0 = the full timeline; violation events are "
@@ -1276,6 +1469,14 @@ def main(argv=None) -> int:
                     help="render the top-N per-client latency rows")
     sp.add_argument("--top", type=int, default=5,
                     help="N for --by-key/--by-client (default 5)")
+    sp.add_argument("--follow", action="store_true",
+                    help="tail live heartbeat inputs (pool --heartbeat / "
+                         "the soak harness): poll each input's run "
+                         "manifest and re-render in place until every run "
+                         "is terminal; inputs with no live manifest "
+                         "degrade to the one-shot render")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="poll period in seconds for --follow (default 2)")
     sp.set_defaults(fn=cmd_stats)
 
     sp = sub.add_parser(
@@ -1303,10 +1504,12 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)
-    if args.cmd == "stats":
-        # a pure host-side renderer: no compiled programs, no accelerator —
-        # skip backend init entirely (a degraded tunnel must not block
-        # reading a report file)
+    if args.cmd == "stats" or (args.cmd == "explain"
+                               and getattr(args, "heartbeat", "")):
+        # pure host-side renderers (stats; explain over a heartbeat
+        # stream): no compiled programs, no accelerator — skip backend
+        # init entirely (a degraded tunnel must not block reading a
+        # report file)
         return args.fn(args)
     # Must run before any backend init. Honors --platform > MADTPU_PLATFORM
     # > JAX_PLATFORMS (re-asserted via jax.config because the container's

@@ -8,6 +8,7 @@ hot path, only for the final violation reduction.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import time
 from typing import NamedTuple, Optional
@@ -19,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from madraft_tpu.tpusim import coverage as _cov
 from madraft_tpu.tpusim import metrics as _metrics
+from madraft_tpu.tpusim import telemetry as _telemetry
 from madraft_tpu.tpusim.config import (
     CoverageConfig,
     Knobs,
@@ -422,6 +424,32 @@ def _pool_summary(n_clusters: int, horizon: int, chunk_ticks: int,
     }
 
 
+def _hb_context(cfg: SimConfig, seed: int, n_clusters: int, horizon: int,
+                chunk_ticks: int, devices: Optional[int],
+                budget_ticks: Optional[int],
+                budget_seconds: Optional[float],
+                coverage: Optional[CoverageConfig] = None) -> dict:
+    """The manifest's config echo (ISSUE 17): enough for a watcher to know
+    WHAT is running (and for budget_frac/ETA) without the launching shell.
+    ``static_key`` is the compiled-program identity — two manifests with
+    the same static_key run the same executables."""
+    ctx = {
+        "kind": "pool",
+        "seed": int(seed),
+        "lanes": int(n_clusters),
+        "horizon": int(horizon),
+        "chunk_ticks": int(chunk_ticks),
+        "devices": devices,
+        "budget_ticks": budget_ticks,
+        "budget_seconds": budget_seconds,
+        "static_key": repr(cfg.static_key()),
+        "config": dataclasses.asdict(cfg),
+    }
+    if coverage is not None:
+        ctx["coverage"] = dataclasses.asdict(coverage)
+    return ctx
+
+
 class _PoolAccount:
     """Host-side harvest accounting shared by every pool path: retirement
     counters, the effective-steps convention (post-violation ticks inside
@@ -432,9 +460,13 @@ class _PoolAccount:
     NEXT chunk executes on device — the overlap — and ``finish`` only
     after that thread joins, so no locking is needed."""
 
-    def __init__(self, on_retired, guided: bool = False):
+    def __init__(self, on_retired, guided: bool = False, heartbeat=None):
         self.on_retired = on_retired
         self.guided = guided
+        # live-telemetry plane (ISSUE 17): a telemetry.HeartbeatWriter (or
+        # None). Driven from consume() — i.e. from the consumer thread,
+        # off the critical path — on already-fetched numpy arrays only.
+        self.heartbeat = heartbeat
         self.retired_total = 0
         self.viol_total = 0
         self.effective = 0
@@ -459,11 +491,15 @@ class _PoolAccount:
         self.lat_ticks_total = 0
         self.worst: Optional[dict] = None
 
-    def consume(self, h, wall: float, children_ran: bool) -> None:
+    def consume(self, h, wall: float, children_ran: bool,
+                timing: Optional[dict] = None) -> None:
         """Account one fetched harvest. ``children_ran`` is True iff a
         following chunk was dispatched, i.e. this harvest's refilled
         children actually ran a tick — the refills_* summary fields claim
-        to record how lanes were actually spent."""
+        to record how lanes were actually spent. ``timing`` is _pipeline's
+        per-generation delta dict (lane_ticks / device_wait_s /
+        dispatch_gap_s) for the heartbeat row's timing column group."""
+        t0c = time.perf_counter() if self.heartbeat is not None else 0.0
         self.last = h
         cov = hasattr(h, "new_fps")
         if cov:
@@ -518,6 +554,12 @@ class _PoolAccount:
             productive = h.retired & (h.new_fps > 0)
             self.refills_mutated += int(productive.sum())
             self.refills_fresh += int((h.retired & ~productive).sum())
+        if self.heartbeat is not None:
+            # this generation's own consume wall IS its host_overlap share
+            # (the work _pipeline hides under the next chunk's execution)
+            t = dict(timing or {})
+            t["host_overlap_s"] = time.perf_counter() - t0c
+            self.heartbeat.generation(self, wall, t)
 
     def finish(self) -> None:
         """In-flight lanes at shutdown are clean (violated => retired):
@@ -602,10 +644,10 @@ def _pipeline(launch_chunk, launch_harvest, acct: _PoolAccount,
             item = q.get()
             if item is None:
                 return
-            h, wall_at_fetch, children_ran = item
+            h, wall_at_fetch, children_ran, timing = item
             t1 = time.perf_counter()
             try:
-                acct.consume(h, wall_at_fetch, children_ran)
+                acct.consume(h, wall_at_fetch, children_ran, timing)
             except BaseException as e:  # surface on the main thread
                 exc.append(e)
                 return
@@ -617,6 +659,7 @@ def _pipeline(launch_chunk, launch_harvest, acct: _PoolAccount,
     lane_ticks = 0
     device_s = 0.0
     t_loop = t0
+    t_prev = t0  # previous fetch end: per-generation dispatch-gap origin
     try:
         while True:
             t1 = time.perf_counter()
@@ -632,9 +675,18 @@ def _pipeline(launch_chunk, launch_harvest, acct: _PoolAccount,
                 (budget_ticks is not None and lane_ticks >= budget_ticks)
                 or (budget_seconds is not None and wall >= budget_seconds)
             )
+            # per-generation timing deltas for the heartbeat row (ISSUE
+            # 17) — the same quantities the run-total telemetry below
+            # sums, sliced at the generation boundary
+            timing = {
+                "lane_ticks": lane_ticks,
+                "device_wait_s": t2 - t1,
+                "dispatch_gap_s": max(0.0, t1 - t_prev),
+            }
+            t_prev = t2
             while not exc:  # a dead worker must not deadlock the put
                 try:
-                    q.put((h, wall, not stop), timeout=1.0)
+                    q.put((h, wall, not stop, timing), timeout=1.0)
                     break
                 except queue_mod.Full:
                     continue
@@ -1066,6 +1118,7 @@ def run_pool(
     on_retired=None,
     coverage: Optional[CoverageConfig] = None,
     pack_states: Optional[bool] = None,
+    heartbeat=None,
 ) -> dict:
     """Continuous fuzzing pool: chunk -> harvest -> refill until the budget
     is spent. ``n_clusters`` lanes stay resident on device; a lane retires
@@ -1114,6 +1167,15 @@ def run_pool(
     resident lane state. Reports are bit-identical across layouts (the
     widen-on-use round trip is exact on the packed path — golden-guard
     property, tests/test_state_layout.py).
+
+    ``heartbeat`` (ISSUE 17): a path (or a ``telemetry.HeartbeatWriter``)
+    for the live-telemetry plane — one JSONL row per harvest generation
+    plus an atomically-replaced ``<path>.manifest.json`` a watcher can
+    attach to. Rows are emitted from the SAME consumer thread that runs
+    ``on_retired`` (never from device code — zero new compiled programs;
+    the lint registry pin and golden guards enforce that statically); the
+    row's ``det`` column group is device-count invariant and layout-blind
+    like the summary it reconciles with, the ``t`` group is wall-clock.
     """
     if horizon < 1:
         raise ValueError(f"pool horizon must be >= 1 tick, got {horizon}")
@@ -1128,7 +1190,12 @@ def run_pool(
             chunk_ticks=chunk_ticks, budget_ticks=budget_ticks,
             budget_seconds=budget_seconds, mesh=mesh, devices=devices,
             on_retired=on_retired, pack_states=pack_states,
+            heartbeat=heartbeat,
         )
+    hb = _telemetry.as_writer(heartbeat)
+    if hb is not None:
+        hb.open(_hb_context(cfg, seed, n_clusters, horizon, chunk_ticks,
+                            devices, budget_ticks, budget_seconds))
     static = cfg.static_key()
     kn = cfg.knobs()
     packed, layout = _choose_layout(cfg, kn, horizon + chunk_ticks,
@@ -1167,28 +1234,40 @@ def run_pool(
     # a cold run's steps_per_sec/violations_per_s never silently include
     # compile time (run_telemetry's measurement-honesty convention). Warm
     # cost: n_clusters ticks + one harvest — noise against any real budget.
-    t_warm = time.perf_counter()
-    ws, wk, wi = init(seed_u, kn, jnp.asarray(0, jnp.int32))
-    wc, wh = steps([ws, wk, wi, book0()], jnp.asarray(1, jnp.int32))
-    wc()
-    jax.block_until_ready(wh().retired)
-    compile_s = time.perf_counter() - t_warm
-    states, keys, ids = init(seed_u, kn, jnp.asarray(0, jnp.int32))
-    state_bytes = tree_bytes(states)  # live resident carry buffers
-    carry = [states, keys, ids, book0()]
-    launch_chunk, launch_harvest = steps(carry, ct)
-    acct = _PoolAccount(on_retired)
-    lane_ticks, wall, gap, wait, overlap = _pipeline(
-        launch_chunk, launch_harvest, acct, chunk_ticks, budget_ticks,
-        budget_seconds,
-    )
-    acct.finish()
-    tele, id_fields = _summary_fields(
-        compile_s, gap, wait, overlap, devices, carry[3], n_clusters,
-        layout, state_bytes,
-    )
-    return _pool_summary(n_clusters, horizon, chunk_ticks, lane_ticks,
-                         acct, wall, tele, id_fields)
+    try:
+        t_warm = time.perf_counter()
+        ws, wk, wi = init(seed_u, kn, jnp.asarray(0, jnp.int32))
+        wc, wh = steps([ws, wk, wi, book0()], jnp.asarray(1, jnp.int32))
+        wc()
+        jax.block_until_ready(wh().retired)
+        compile_s = time.perf_counter() - t_warm
+        states, keys, ids = init(seed_u, kn, jnp.asarray(0, jnp.int32))
+        state_bytes = tree_bytes(states)  # live resident carry buffers
+        carry = [states, keys, ids, book0()]
+        launch_chunk, launch_harvest = steps(carry, ct)
+        acct = _PoolAccount(on_retired, heartbeat=hb)
+        lane_ticks, wall, gap, wait, overlap = _pipeline(
+            launch_chunk, launch_harvest, acct, chunk_ticks, budget_ticks,
+            budget_seconds,
+        )
+        acct.finish()
+        tele, id_fields = _summary_fields(
+            compile_s, gap, wait, overlap, devices, carry[3], n_clusters,
+            layout, state_bytes,
+        )
+        summary = _pool_summary(n_clusters, horizon, chunk_ticks,
+                                lane_ticks, acct, wall, tele, id_fields)
+    except BaseException:
+        # in-process failure: terminal manifest status "failed" (an
+        # abrupt SIGKILL instead leaves "running" + a dead pid, which
+        # telemetry.manifest_status reads back as "crashed")
+        if hb is not None:
+            hb.close("failed")
+        raise
+    if hb is not None:
+        hb.final_row(acct, lane_ticks, wall, tele)
+        hb.close("done")
+    return summary
 
 
 # --------------------------------------------------------------------------
@@ -1426,6 +1505,7 @@ def _run_pool_coverage(
     devices: Optional[int],
     on_retired,
     pack_states: Optional[bool] = None,
+    heartbeat=None,
 ) -> dict:
     """run_pool's coverage-guided body (see run_pool for the contract).
 
@@ -1446,6 +1526,11 @@ def _run_pool_coverage(
     row still replays bit-exactly from its recorded knob row.
     """
     sharded = devices is not None
+    hb = _telemetry.as_writer(heartbeat)
+    if hb is not None:
+        hb.open(_hb_context(cfg, seed, n_clusters, horizon, chunk_ticks,
+                            devices, budget_ticks, budget_seconds,
+                            coverage=ccfg))
     static = cfg.static_key()
     base_kn = cfg.knobs()
     packed, layout = _choose_layout(cfg, base_kn, horizon + chunk_ticks,
@@ -1511,26 +1596,34 @@ def _run_pool_coverage(
 
     # warm all programs outside the timed window (run_pool convention; the
     # tick count is a runtime bound so 1 tick compiles the real executables)
-    t_warm = time.perf_counter()
-    wc, wh = steps(fresh_carry(), jnp.asarray(1, jnp.int32))
-    wc()
-    jax.block_until_ready(wh().retired)
-    compile_s = time.perf_counter() - t_warm
-    carry = fresh_carry()
-    state_bytes = tree_bytes(carry[0])  # live resident carry buffers
-    launch_chunk, launch_harvest = steps(carry, ct)
-    acct = _PoolAccount(on_retired, guided=ccfg.guided)
-    lane_ticks, wall, gap, wait, overlap = _pipeline(
-        launch_chunk, launch_harvest, acct, chunk_ticks, budget_ticks,
-        budget_seconds,
-    )
-    acct.finish()
-    tele, id_fields = _summary_fields(
-        compile_s, gap, wait, overlap, devices, carry[3], n_clusters,
-        layout, state_bytes,
-    )
-    summary = _pool_summary(n_clusters, horizon, chunk_ticks, lane_ticks,
-                            acct, wall, tele, id_fields)
+    try:
+        t_warm = time.perf_counter()
+        wc, wh = steps(fresh_carry(), jnp.asarray(1, jnp.int32))
+        wc()
+        jax.block_until_ready(wh().retired)
+        compile_s = time.perf_counter() - t_warm
+        carry = fresh_carry()
+        state_bytes = tree_bytes(carry[0])  # live resident carry buffers
+        launch_chunk, launch_harvest = steps(carry, ct)
+        acct = _PoolAccount(on_retired, guided=ccfg.guided, heartbeat=hb)
+        lane_ticks, wall, gap, wait, overlap = _pipeline(
+            launch_chunk, launch_harvest, acct, chunk_ticks, budget_ticks,
+            budget_seconds,
+        )
+        acct.finish()
+        tele, id_fields = _summary_fields(
+            compile_s, gap, wait, overlap, devices, carry[3], n_clusters,
+            layout, state_bytes,
+        )
+        summary = _pool_summary(n_clusters, horizon, chunk_ticks,
+                                lane_ticks, acct, wall, tele, id_fields)
+    except BaseException:
+        if hb is not None:
+            hb.close("failed")  # SIGKILL instead reads back as "crashed"
+        raise
+    if hb is not None:
+        hb.final_row(acct, lane_ticks, wall, tele)
+        hb.close("done")
     summary["coverage"] = {
         "bitmap_bits": ccfg.bitmap_bits,
         "identity": _cov.identity_mapped(cfg.n_nodes, ccfg),

@@ -1073,6 +1073,17 @@ def _cfg_dims(*cfgs):
     return out
 
 
+# The pinned cached-program count (ISSUE 17): the host-side telemetry
+# plane (tpusim/telemetry.py) must add NO compiled programs and no host
+# callbacks to any hot-path jaxpr — the zero_when_off discipline extended
+# to the whole registry. Callback primitives are caught per-equation by
+# _CALLBACK_PRIMS above for EVERY traced program; this count catches the
+# other half (a new program sneaking in off-registry or on it silently).
+# A deliberate new program updates this constant in the same commit that
+# registers it.
+REGISTRY_PROGRAMS = 31
+
+
 def registry() -> list:
     """Every cached compiled program, with its static config and abstract
     input shapes — the single enumeration the lint passes, the golden
@@ -1350,6 +1361,11 @@ def registry() -> list:
         "shardkv", sk_scfg, skcfg, _shardkv_program, None,
         init_shardkv_cluster, pack_shardkv_state, skcfg.knobs(),
         "shardkv.fuzz", n_extra_init=(skcfg.knobs(),))
+    assert len(specs) == REGISTRY_PROGRAMS, (
+        f"cached-program count changed: {len(specs)} != "
+        f"{REGISTRY_PROGRAMS} — host-side planes (telemetry) must not add "
+        f"programs; a deliberate new program updates REGISTRY_PROGRAMS"
+    )
     return specs
 
 

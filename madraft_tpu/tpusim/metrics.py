@@ -262,6 +262,39 @@ def latency_summary(hist, ms_per_tick: Optional[int] = None) -> dict:
     return out
 
 
+def hist_window(now, prev) -> np.ndarray:
+    """Window delta of two cumulative histogram snapshots. Fixed edges
+    make this exact: cumulative histograms only ever grow by addition, so
+    the delta IS the histogram of the window's samples, and summing every
+    window row of a heartbeat stream reproduces the cumulative histogram
+    bit-for-bit (the ``stats`` merge relies on this)."""
+    h = np.asarray(now, dtype=np.int64)
+    if prev is None:
+        return h.copy()
+    return h - np.asarray(prev, dtype=np.int64)
+
+
+def window_latency(now, prev) -> dict:
+    """The heartbeat row's windowed latency digest: op count and p50/p99
+    decoded from the WINDOW histogram (``*_w`` column convention), plus the
+    raw window row so downstream merges stay additive."""
+    h = hist_window(now, prev)
+    return {
+        "ops_w": int(h.sum()),
+        "p50_w": quantile_from_hist(h, 0.50),
+        "p99_w": quantile_from_hist(h, 0.99),
+        "hist_w": [int(x) for x in h],
+    }
+
+
+def window_phase_ticks(now, prev) -> dict:
+    """Per-phase exact tick totals for one window, keyed by name (the same
+    by-name convention as phases_summary, so heartbeat phase columns merge
+    with report phases downstream)."""
+    d = hist_window(now, prev)
+    return {name: int(d[p]) for p, name in enumerate(phase_names(len(d)))}
+
+
 def event_summary(ev) -> dict:
     """METRIC_EVENTS-keyed counter dict from one merged ev_counts row."""
     ev = np.asarray(ev, dtype=np.int64)

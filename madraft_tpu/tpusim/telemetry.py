@@ -1,0 +1,349 @@
+"""Host-side live-telemetry plane (ISSUE 17; ROADMAP item 4).
+
+A long-running pool or soak is opaque between launch and final summary:
+the pipeline timers, the latency plane, and the coverage curve are only
+SUMMED into the end-of-run summary. This module is the one copy of the
+plane that fixes that — a heartbeat stream (one JSONL row per harvest
+generation) plus an atomically-replaced run manifest an external watcher
+can use to discover a live run and distinguish crashed from running from
+done. Everything here runs on the host, off the hot path: the engine
+calls it only from the PR-7 harvest-consumer thread, on already-fetched
+numpy arrays, so the compiled-program set is untouched (the lint registry
+pin and the golden fuzz/pool guards say so statically).
+
+Heartbeat row schema (v1) — two clearly-separated column groups:
+
+  {"hb": 1, "gen": G, "lane_ticks": T, ["final": true,]
+   "det": { ... },       # DETERMINISTIC: pure functions of
+                         # (seed, config, chunk cadence, budget_ticks) —
+                         # device-count invariant (1-vs-2, lane scheme)
+                         # and state-layout blind, test-pinned
+   "t":   { ... }}       # TIMING: wall clock, rates, per-generation
+                         # pipeline deltas, ETA — explicitly NOT
+                         # deterministic, never compared across runs
+
+``det`` carries: retired / violating cumulative + ``*_w`` window counts,
+``effective_steps``, the coverage discovery counters when the run is a
+coverage pool (``new_fps``/``new_fps_w``/``refills_*`` — deterministic per
+FIXED device count only: per-shard novelty is topology-dependent), and a
+``latency`` sub-dict (window ops/p50/p99 + the raw window histogram and
+per-phase tick totals, merged via metrics.py's fixed-bucket fold) when the
+metrics plane is on. ``t`` carries wall_s, window violations/s and fp/s,
+the per-generation dispatch_gap_s / device_wait_s / host_overlap_s deltas
+from the pipeline, and budget_frac / eta_s against the run budget.
+
+The manifest ``<heartbeat>.manifest.json`` is REPLACED atomically
+(tmp + os.replace) on every generation, so it is always valid JSON:
+
+  {"schema": 1, "status": "running" | "done" | "failed", "pid": ...,
+   "heartbeat": <basename>, "context": {config echo, static_key, seed,
+   lanes, horizon, chunk_ticks, devices, budget}, "last_gen": G,
+   "lane_ticks": T, "retired": R, "violating": V, "updated_unix": ...}
+
+``manifest_status`` folds in pid liveness: a manifest stuck at "running"
+whose pid is gone reads as "crashed" — the watcher-side tri-state. (Pid
+liveness is same-host only; a watcher on another machine sees "running"
+until the writer's terminal update.)
+
+This module imports nothing heavier than numpy at module scope so the
+C++-side soak (_cpp_soak.py) and the `stats` verb (which skips backend
+init entirely) can use it without touching JAX.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional
+
+HEARTBEAT_SCHEMA = 1
+
+
+# ------------------------------------------------------------- manifest
+def manifest_path(heartbeat_path) -> str:
+    """The manifest's one naming rule: ``<heartbeat>.manifest.json``."""
+    return str(heartbeat_path) + ".manifest.json"
+
+
+def write_json_atomic(path: str, doc: dict) -> None:
+    """tmp + os.replace: a reader (or an abrupt kill) can never observe a
+    half-written file — the _soak checkpoint convention, promoted here."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+def read_manifest(path: str) -> Optional[dict]:
+    """Load a manifest (tolerates a heartbeat path — resolves the naming
+    rule). None when absent or unparsable mid-replace is impossible by
+    construction, so unparsable means 'not a manifest'."""
+    if not path.endswith(".manifest.json"):
+        path = manifest_path(path)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def pid_alive(pid: int) -> bool:
+    try:
+        os.kill(int(pid), 0)
+    except (OSError, ValueError):
+        return False
+    return True
+
+
+def manifest_status(doc: Optional[dict]) -> str:
+    """The watcher tri-state: 'done' / 'failed' are terminal as written;
+    'running' with a dead pid decays to 'crashed' (the writer never got to
+    its terminal update); anything unreadable is 'unknown'."""
+    if not isinstance(doc, dict) or "status" not in doc:
+        return "unknown"
+    status = doc["status"]
+    if status != "running":
+        return status
+    return "running" if pid_alive(doc.get("pid", -1)) else "crashed"
+
+
+def is_terminal(status: str) -> bool:
+    return status in ("done", "failed", "crashed")
+
+
+# ------------------------------------------------------- heartbeat reader
+def read_heartbeat(lines) -> list:
+    """Parse heartbeat rows out of a line iterable (skips anything that
+    isn't a v-known hb row — pool JSONL reports interleave freely)."""
+    rows = []
+    for raw in lines:
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            doc = json.loads(raw)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict) and doc.get("hb") == HEARTBEAT_SCHEMA:
+            rows.append(doc)
+    return rows
+
+
+def digest_line(row: dict) -> str:
+    """The one-line human digest of a heartbeat row (`pool`'s stderr
+    --digest-every cadence and the soaks share this spelling):
+    ``gen 12 · 38% of budget · viol/s 0.41 · p99 127``."""
+    det = row.get("det", {})
+    t = row.get("t", {})
+    parts = [f"gen {row.get('gen', '?')}"]
+    frac = t.get("budget_frac")
+    if frac is not None:
+        parts.append(f"{100.0 * frac:.0f}% of budget")
+    vps = t.get("viol_per_s")
+    if vps is not None:
+        parts.append(f"viol/s {vps}")
+    lat = det.get("latency")
+    if isinstance(lat, dict) and lat.get("p99_w") is not None:
+        parts.append(f"p99 {lat['p99_w']}")
+    fps = t.get("fp_per_s_w")
+    if fps is not None:
+        parts.append(f"fp/s {fps}")
+    return " · ".join(parts)
+
+
+# -------------------------------------------------------------- the writer
+class HeartbeatWriter:
+    """One heartbeat stream + manifest for one run.
+
+    Construction is cheap and JAX-free; ``open(context)`` binds the run
+    (the engine calls it before its warm-up so the manifest exists the
+    moment the run is discoverable). ``path=None`` keeps the row pipeline
+    (generation counting, ``on_row`` digests) without any file output —
+    what `pool --digest-every` without --heartbeat uses.
+
+    Thread contract: after ``open``, every method runs on ONE thread (the
+    pool's harvest consumer; the soaks' main thread) — same no-locking
+    rule as _PoolAccount.
+    """
+
+    def __init__(self, path=None, *,
+                 on_row: Optional[Callable[[dict], None]] = None):
+        self.path = str(path) if path else None
+        self.on_row = on_row
+        self.context: dict = {}
+        self.gen = 0
+        self._f = None
+        self._snap: Optional[dict] = None  # previous cumulative snapshot
+
+    # ------------------------------------------------------------ plumbing
+    def open(self, context: dict) -> None:
+        self.context = dict(context)
+        if self.path:
+            self._f = open(self.path, "w")
+            self._manifest("running")
+
+    def _manifest(self, status: str, **extra) -> None:
+        if not self.path:
+            return
+        doc = {
+            "schema": HEARTBEAT_SCHEMA,
+            "status": status,
+            "pid": os.getpid(),
+            "heartbeat": os.path.basename(self.path),
+            "context": self.context,
+            "last_gen": self.gen - 1 if self.gen else None,
+            "updated_unix": round(time.time(), 3),
+            **extra,
+        }
+        write_json_atomic(manifest_path(self.path), doc)
+
+    def row(self, det: dict, t: dict, lane_ticks=None,
+            final: bool = False) -> dict:
+        """Emit one raw row (the soaks' direct entry; ``generation`` and
+        ``final_row`` build the pool rows on top of this)."""
+        doc = {"hb": HEARTBEAT_SCHEMA, "gen": self.gen}
+        if lane_ticks is not None:
+            doc["lane_ticks"] = int(lane_ticks)
+        if final:
+            doc["final"] = True
+        doc["det"] = det
+        doc["t"] = t
+        if self._f is not None:
+            self._f.write(json.dumps(doc) + "\n")
+            self._f.flush()
+        self.gen += 1
+        self._manifest("running", lane_ticks=doc.get("lane_ticks"),
+                       retired=det.get("retired"),
+                       violating=det.get("violating"))
+        if self.on_row is not None:
+            self.on_row(doc)
+        return doc
+
+    def close(self, status: str = "done") -> None:
+        """Terminal manifest update + stream close. Idempotent, and safe
+        to call with no prior open (a run that died before warming)."""
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+            self._manifest(status)
+
+    # ------------------------------------------------- pool-account bridge
+    def _cumulative(self, acct) -> dict:
+        """Snapshot the account's cumulative counters (copies the mutable
+        arrays so window deltas are against a frozen point)."""
+        import numpy as np
+
+        snap = {
+            "retired": acct.retired_total,
+            "violating": acct.viol_total,
+            "effective": int(acct.effective),
+            "seen_fps": acct.seen_prev,
+            "refills_mutated": acct.refills_mutated,
+            "refills_fresh": acct.refills_fresh,
+            "hist": None,
+            "phase_ticks": None,
+        }
+        if acct.hist_total is not None:
+            snap["hist"] = np.array(acct.hist_total, np.int64)
+            snap["phase_ticks"] = np.array(acct.phase_ticks_total, np.int64)
+        return snap
+
+    def _det(self, acct, cov: bool, now: dict, prev: Optional[dict]) -> dict:
+        from madraft_tpu.tpusim import metrics as _metrics
+
+        p = prev or {}
+        det = {
+            "retired": now["retired"],
+            "retired_w": now["retired"] - p.get("retired", 0),
+            "violating": now["violating"],
+            "violating_w": now["violating"] - p.get("violating", 0),
+            "effective_steps": now["effective"],
+        }
+        if cov:
+            det["new_fps"] = now["seen_fps"]
+            det["new_fps_w"] = now["seen_fps"] - p.get("seen_fps", 0)
+            det["refills_mutated"] = now["refills_mutated"]
+            det["refills_fresh"] = now["refills_fresh"]
+        if now["hist"] is not None:
+            det["latency"] = _metrics.window_latency(
+                now["hist"], p.get("hist"))
+            det["latency"]["phase_ticks_w"] = _metrics.window_phase_ticks(
+                now["phase_ticks"], p.get("phase_ticks"))
+        return det
+
+    def _timing(self, det: dict, wall: float, timing: Optional[dict],
+                prev_wall: float) -> dict:
+        t = {"wall_s": round(wall, 4)}
+        if wall > 0:
+            t["viol_per_s"] = round(det["violating"] / wall, 3)
+        dw = wall - prev_wall
+        if dw > 0:
+            t["viol_per_s_w"] = round(det["violating_w"] / dw, 3)
+            if "new_fps_w" in det:
+                t["fp_per_s_w"] = round(det["new_fps_w"] / dw, 2)
+        for k in ("dispatch_gap_s", "device_wait_s", "host_overlap_s"):
+            if timing and k in timing:
+                t[k] = round(timing[k], 5)
+        frac = None
+        bt = self.context.get("budget_ticks")
+        bs = self.context.get("budget_seconds")
+        if bt and timing and timing.get("lane_ticks"):
+            frac = min(1.0, timing["lane_ticks"] / bt)
+        elif bs:
+            frac = min(1.0, wall / bs)
+        if frac is not None:
+            t["budget_frac"] = round(frac, 4)
+            if 0 < frac < 1:
+                t["eta_s"] = round(wall * (1.0 - frac) / frac, 2)
+        return t
+
+    def generation(self, acct, wall: float,
+                   timing: Optional[dict]) -> None:
+        """One per-harvest-generation row, called from _PoolAccount.consume
+        on the consumer thread (numpy only — never into JAX)."""
+        now = self._cumulative(acct)
+        cov = bool(acct.new_fp_per_gen)
+        det = self._det(acct, cov, now, self._snap)
+        prev_wall = (self._snap or {}).get("wall", 0.0)
+        t = self._timing(det, wall, timing, prev_wall)
+        now["wall"] = wall
+        lane_ticks = timing.get("lane_ticks") if timing else None
+        self._snap = now
+        self.row(det, t, lane_ticks=lane_ticks)
+
+    def final_row(self, acct, lane_ticks: int, wall: float,
+                  tele: dict) -> None:
+        """The reconciliation row after acct.finish(): cumulative columns
+        equal to the pool summary EXACTLY (test-pinned), with the finish
+        window (in-flight lanes) as this row's ``*_w`` deltas so a stats
+        merge over the whole stream sums to the run total."""
+        from madraft_tpu.tpusim import metrics as _metrics
+
+        now = self._cumulative(acct)
+        cov = bool(acct.new_fp_per_gen)
+        det = self._det(acct, cov, now, self._snap)
+        if now["hist"] is not None:
+            # the summary-facing cumulative latency digest, next to the
+            # finish-window fields _det computed
+            cum = _metrics.latency_summary(now["hist"])
+            det["latency"].update({
+                "ops": cum["ops"],
+                "p50_ticks": cum["p50_ticks"],
+                "p99_ticks": cum["p99_ticks"],
+                "ticks_total": acct.lat_ticks_total,
+            })
+        prev_wall = (self._snap or {}).get("wall", 0.0)
+        t = self._timing(det, wall, None, prev_wall)
+        for k in ("dispatch_gap_s", "device_wait_s", "host_overlap_s"):
+            if k in tele:
+                t[k] = tele[k]
+        self.row(det, t, lane_ticks=lane_ticks, final=True)
+
+
+def as_writer(heartbeat) -> Optional[HeartbeatWriter]:
+    """The engine's coercion rule: None passes through, a path becomes a
+    writer, a writer is used as-is (what `pool --digest-every` hands in)."""
+    if heartbeat is None or isinstance(heartbeat, HeartbeatWriter):
+        return heartbeat
+    return HeartbeatWriter(heartbeat)

@@ -486,3 +486,75 @@ def chrome_trace(
                 },
             })
     return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def chrome_pool_timeline(rows: list, label: str = "pool",
+                         manifest: Optional[dict] = None) -> dict:
+    """Chrome/Perfetto trace-event JSON for a pool heartbeat stream (ISSUE
+    17): the HOST timeline of a run — per-generation dispatch-gap /
+    device-execution spans on a "device loop" track and the consumer
+    thread's consume+emit span on a "host consume" track, plus counter
+    tracks for window violations/s, coverage growth, window p99 and
+    device_wait. This renders the PR-7 overlap claim over TIME: the host
+    span of generation k sits under the device span of generation k+1
+    exactly when the pipeline is doing its job, instead of being three
+    summed scalars in the summary.
+
+    ``rows`` are telemetry.read_heartbeat rows; ts is the row's wall_s (the
+    fetch end of that generation) in microseconds. The final reconciliation
+    row carries run-total timers, not per-generation deltas, so it
+    contributes counters only, never spans. ``manifest`` (if given) rides
+    the process metadata so the trace is self-describing."""
+    out = [{"name": "process_name", "ph": "M", "pid": 0,
+            "args": {"name": label,
+                     **({"manifest": manifest} if manifest else {})}},
+           {"name": "thread_name", "ph": "M", "pid": 0, "tid": 0,
+            "args": {"name": "device loop"}},
+           {"name": "thread_name", "ph": "M", "pid": 0, "tid": 1,
+            "args": {"name": "host consume"}}]
+    for row in rows:
+        det = row.get("det", {})
+        t = row.get("t", {})
+        wall = t.get("wall_s")
+        if wall is None:
+            continue
+        ts = wall * 1e6
+        args = {"gen": row.get("gen"), "lane_ticks": row.get("lane_ticks"),
+                "retired_w": det.get("retired_w"),
+                "violating_w": det.get("violating_w")}
+        if not row.get("final"):
+            wait = t.get("device_wait_s")
+            gap = t.get("dispatch_gap_s")
+            if wait is not None:
+                out.append({"name": f"chunk+harvest g{row.get('gen')}",
+                            "ph": "X", "pid": 0, "tid": 0,
+                            "ts": (wall - wait) * 1e6, "dur": wait * 1e6,
+                            "args": args})
+                if gap:
+                    out.append({"name": "dispatch gap", "ph": "X",
+                                "pid": 0, "tid": 0,
+                                "ts": (wall - wait - gap) * 1e6,
+                                "dur": gap * 1e6, "args": {}})
+            host = t.get("host_overlap_s")
+            if host is not None:
+                # the consumer's work for generation g runs from the fetch
+                # onward, under generation g+1's device execution
+                out.append({"name": f"consume+emit g{row.get('gen')}",
+                            "ph": "X", "pid": 0, "tid": 1, "ts": ts,
+                            "dur": max(host, 1e-6) * 1e6, "args": args})
+        if t.get("viol_per_s_w") is not None:
+            out.append({"name": "violations_per_s", "ph": "C", "pid": 0,
+                        "ts": ts,
+                        "args": {"window": t["viol_per_s_w"]}})
+        if det.get("new_fps") is not None:
+            out.append({"name": "coverage_fingerprints", "ph": "C",
+                        "pid": 0, "ts": ts,
+                        "args": {"seen": det["new_fps"]}})
+        lat = det.get("latency")
+        if isinstance(lat, dict) and lat.get("p99_w") is not None:
+            out.append({"name": "latency_p99_ticks", "ph": "C", "pid": 0,
+                        "ts": ts, "args": {"p99_w": lat["p99_w"]}})
+        if not row.get("final") and t.get("device_wait_s") is not None:
+            out.append({"name": "device_wait_s", "ph": "C", "pid": 0,
+                        "ts": ts, "args": {"wait": t["device_wait_s"]}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

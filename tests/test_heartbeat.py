@@ -1,0 +1,275 @@
+"""Live run-telemetry plane (ISSUE 17): the heartbeat stream's
+deterministic columns are device-count invariant and state-layout blind,
+the final row reconciles EXACTLY with the pool summary (windows sum to the
+cumulative histogram bit-for-bit), the manifest is atomically replaced and
+a SIGKILLed writer reads as "crashed", `stats --follow` on a finished
+stream renders byte-identically to one-shot, and the Perfetto export from
+a heartbeat file is a valid Chrome trace. Everything here is host-side —
+the companion static pin is tests/test_lint.py's REGISTRY_PROGRAMS == 31
+(the plane adds zero compiled programs)."""
+
+import contextlib
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from madraft_tpu.__main__ import main
+from madraft_tpu.tpusim import SimConfig
+from madraft_tpu.tpusim.config import CoverageConfig, storm_profiles
+from madraft_tpu.tpusim.engine import run_pool
+from madraft_tpu.tpusim.telemetry import (
+    HEARTBEAT_SCHEMA,
+    HeartbeatWriter,
+    digest_line,
+    manifest_path,
+    manifest_status,
+    read_heartbeat,
+    read_manifest,
+)
+
+STORM = SimConfig(
+    n_nodes=5, p_client_cmd=0.2, loss_prob=0.1, p_crash=0.01, p_restart=0.2,
+    max_dead=2, p_repartition=0.02, p_heal=0.05,
+)
+VIOL = STORM.replace(majority_override=2)
+DURABILITY = storm_profiles()["durability"][0]
+
+
+def _pool_rows(tmp_path, name, cfg, **kw):
+    hb = str(tmp_path / f"{name}.jsonl")
+    summary = run_pool(cfg, kw.pop("seed", 7), kw.pop("n", 16),
+                       kw.pop("horizon", 64),
+                       chunk_ticks=kw.pop("chunk_ticks", 32),
+                       budget_ticks=kw.pop("budget_ticks", 320),
+                       heartbeat=hb, **kw)
+    with open(hb) as f:
+        rows = read_heartbeat(f)
+    return hb, rows, summary
+
+
+def run_cli(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        try:
+            rc = main(argv)
+        except SystemExit as e:
+            rc = e.code
+    return rc, buf.getvalue()
+
+
+# --------------------------------------------------------- det invariance
+def test_det_columns_device_count_invariant(tmp_path):
+    # the ISSUE-17 pin: per-generation DETERMINISTIC columns are pure
+    # functions of (seed, config, chunk cadence, budget) — the lane-
+    # partitioned id scheme makes the same clusters retire in the same
+    # generations on 1 and 2 devices
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a >= 2-device mesh")
+    _, r1, s1 = _pool_rows(tmp_path, "d1", VIOL, devices=1)
+    _, r2, s2 = _pool_rows(tmp_path, "d2", VIOL, devices=2)
+    assert len(r1) == len(r2) and len(r1) >= 2
+    det1 = [(r["gen"], r.get("lane_ticks"), r["det"]) for r in r1]
+    det2 = [(r["gen"], r.get("lane_ticks"), r["det"]) for r in r2]
+    assert det1 == det2
+    assert s1["retired"] == s2["retired"]
+
+
+def test_det_columns_layout_blind(tmp_path):
+    # packed vs wide state layout changes bytes moved, never observations —
+    # with the metrics plane ON the latency window columns must also match
+    cfg = DURABILITY.replace(bug="ack_before_fsync", metrics=True)
+    _, rw, sw = _pool_rows(tmp_path, "wide", cfg, seed=3, horizon=100,
+                           chunk_ticks=50, budget_ticks=300,
+                           pack_states=False)
+    _, rp, sp = _pool_rows(tmp_path, "packed", cfg, seed=3, horizon=100,
+                           chunk_ticks=50, budget_ticks=300,
+                           pack_states=True)
+    assert sw["state_layout"] == "wide" and sp["state_layout"] == "packed"
+    assert [(r["gen"], r.get("lane_ticks"), r["det"]) for r in rw] == \
+        [(r["gen"], r.get("lane_ticks"), r["det"]) for r in rp]
+
+
+# ------------------------------------------------- final-row reconciliation
+def test_final_row_reconciles_with_summary_exactly(tmp_path):
+    cfg = DURABILITY.replace(bug="ack_before_fsync", metrics=True)
+    _, rows, s = _pool_rows(tmp_path, "fin", cfg, seed=3, horizon=100,
+                            chunk_ticks=50, budget_ticks=300)
+    fin = rows[-1]
+    assert fin.get("final") is True
+    assert fin["lane_ticks"] == s["lane_ticks"]
+    det = fin["det"]
+    assert det["retired"] == s["retired"]
+    assert det["violating"] == s["retired_violating"]
+    assert det["effective_steps"] == s["effective_cluster_steps"]
+    lat = det["latency"]
+    assert lat["ops"] == s["latency"]["ops"]
+    assert lat["p50_ticks"] == s["latency"]["p50_ticks"]
+    assert lat["p99_ticks"] == s["latency"]["p99_ticks"]
+    assert lat["ticks_total"] == s["latency"]["ticks_total"]
+    # window columns across ALL rows (final's window is the finish merge)
+    # sum to the cumulative histogram bit-for-bit — the stats-merge
+    # invariant that makes a stream fold equal the run total
+    hist_sum = np.sum([r["det"]["latency"]["hist_w"] for r in rows], axis=0)
+    np.testing.assert_array_equal(hist_sum, np.asarray(s["latency"]["hist"]))
+    assert sum(r["det"]["retired_w"] for r in rows) == s["retired"]
+    assert sum(r["det"]["violating_w"] for r in rows) == s["retired_violating"]
+
+
+def test_coverage_pool_heartbeat_reconciles(tmp_path):
+    # coverage runs add the discovery columns; final cumulative values must
+    # equal the summary's coverage dict (deterministic per fixed devices)
+    _, rows, s = _pool_rows(tmp_path, "cov", VIOL,
+                            coverage=CoverageConfig())
+    fin = rows[-1]["det"]
+    cov = s["coverage"]
+    assert fin["new_fps"] == cov["seen_fingerprints"]
+    assert fin["refills_mutated"] == cov["refills_mutated"]
+    assert fin["refills_fresh"] == cov["refills_fresh"]
+    assert sum(r["det"]["new_fps_w"] for r in rows) == cov["seen_fingerprints"]
+
+
+# ---------------------------------------------------------------- manifest
+def test_manifest_tracks_rows_and_lands_terminal(tmp_path):
+    hb, rows, s = _pool_rows(tmp_path, "man", VIOL)
+    man = read_manifest(hb)
+    assert man["schema"] == HEARTBEAT_SCHEMA
+    assert manifest_status(man) == "done"
+    assert man["last_gen"] == rows[-1]["gen"]
+    assert man["heartbeat"] == os.path.basename(hb)
+    ctx = man["context"]
+    assert ctx["kind"] == "pool" and ctx["seed"] == 7
+    assert ctx["budget_ticks"] == 320
+    assert "static_key" in ctx and ctx["config"]["n_nodes"] == 5
+
+
+def test_manifest_atomic_and_crash_detectable(tmp_path):
+    # a writer SIGKILLed mid-stream must leave (a) a parseable manifest —
+    # tmp + os.replace means no torn write is ever observable — and (b) a
+    # pid trail that decays "running" -> "crashed" for the watcher. The
+    # child drives HeartbeatWriter directly (file-path import, no JAX) so
+    # the kill lands mid-row-loop deterministically and cheaply.
+    hb = str(tmp_path / "killed.jsonl")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = (
+        "import importlib.util, os, sys, time\n"
+        "spec = importlib.util.spec_from_file_location('t', os.path.join("
+        f"{root!r}, 'madraft_tpu', 'tpusim', 'telemetry.py'))\n"
+        "t = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(t)\n"
+        "hb = t.HeartbeatWriter(sys.argv[1])\n"
+        "hb.open({'kind': 'kill_test'})\n"
+        "for g in range(10 ** 6):\n"
+        "    hb.row({'retired': g}, {'wall_s': g * 1e-3})\n"
+        "    time.sleep(0.002)\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", child, hb])
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            man = read_manifest(hb)
+            if man and man.get("last_gen") is not None:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("child never emitted a heartbeat row")
+        assert manifest_status(man) == "running"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    man = read_manifest(hb)
+    assert man is not None and man["status"] == "running"
+    assert manifest_status(man) == "crashed", man
+    # every generation the manifest claims was flushed to the stream BEFORE
+    # the manifest was replaced, so the pointer never over-promises
+    with open(hb) as f:
+        assert len(read_heartbeat(f)) >= man["last_gen"] + 1
+
+
+# ------------------------------------------------------------ CLI surfaces
+def test_stats_follow_final_render_equals_one_shot(tmp_path):
+    # on a stream whose manifest is terminal, --follow degrades to exactly
+    # one render pass through the SAME code path as one-shot — byte equality
+    hb, _, _ = _pool_rows(tmp_path, "follow", VIOL)
+    rc1, once = run_cli(["stats", hb])
+    rc2, followed = run_cli(["stats", "--follow", "--interval", "0.1", hb])
+    assert rc1 == 0 and rc2 == 0
+    assert followed == once
+
+
+def test_stats_renders_live_pool_block(tmp_path):
+    hb, rows, s = _pool_rows(tmp_path, "live", VIOL)
+    rc, out = run_cli(["stats", hb])
+    assert rc == 0
+    assert "[final]" in out
+    assert f"gen {rows[-1]['gen']}" in out
+    assert f"retired {s['retired']}" in out
+
+
+def test_explain_heartbeat_chrome_trace(tmp_path):
+    hb, rows, _ = _pool_rows(tmp_path, "chrome", VIOL)
+    rc, out = run_cli(["explain", "--heartbeat", hb, "--format", "chrome"])
+    assert rc == 0
+    trace = json.loads(out)
+    evs = trace["traceEvents"]
+    phases = {e["ph"] for e in evs}
+    assert {"M", "X", "C"} <= phases
+    spans = [e for e in evs if e["ph"] == "X"]
+    gens = {r["gen"] for r in rows if not r.get("final")}
+    assert {e["name"] for e in spans} >= {f"chunk+harvest g{g}" for g in gens}
+    counters = {e["name"] for e in evs if e["ph"] == "C"}
+    assert "violations_per_s" in counters and "device_wait_s" in counters
+    # --out writes the trace file and prints a pointer header instead
+    out_file = tmp_path / "trace.json"
+    rc, header = run_cli(["explain", "--heartbeat", hb, "--format", "chrome",
+                          "--out", str(out_file)])
+    assert rc == 0
+    assert json.loads(header)["trace_events"] == len(evs)
+    assert json.loads(out_file.read_text())["traceEvents"]
+
+
+def test_explain_heartbeat_requires_chrome_format(tmp_path):
+    hb, _, _ = _pool_rows(tmp_path, "fmt", VIOL)
+    rc, _ = run_cli(["explain", "--heartbeat", hb])
+    assert rc == 2  # usage error, not a finding
+
+
+def test_pool_digest_every_stderr(tmp_path, capsys):
+    hb = str(tmp_path / "digest.jsonl")
+    rc, _ = run_cli(["pool", "--clusters", "16", "--ticks", "64",
+                     "--chunk-ticks", "32", "--budget-ticks", "320",
+                     "--seed", "7", "--majority-override", "2",
+                     "--heartbeat", hb, "--digest-every", "2"])
+    assert rc == 1  # violations retired -> finding exit
+    err = capsys.readouterr().err
+    digests = [ln for ln in err.splitlines() if ln.startswith("pool: gen ")]
+    assert digests and all("% of budget" in ln for ln in digests)
+    # the digest spelling is shared with the soaks via digest_line
+    with open(hb) as f:
+        rows = read_heartbeat(f)
+    even = [r for r in rows if not r.get("final") and r["gen"] % 2 == 0]
+    assert len(digests) == len(even)
+    assert digests[0] == f"pool: {digest_line(even[0])}"
+
+
+def test_pathless_writer_keeps_digest_pipeline():
+    # --digest-every without --heartbeat: rows flow to on_row, no file I/O
+    seen = []
+    hb = HeartbeatWriter(on_row=seen.append)
+    hb.open({"kind": "pool", "budget_ticks": 100})
+    hb.row({"retired": 4, "retired_w": 4, "violating": 1, "violating_w": 1,
+            "effective_steps": 64}, {"wall_s": 0.5})
+    hb.close()
+    assert len(seen) == 1 and seen[0]["gen"] == 0
+    assert "gen 0" in digest_line(seen[0])
+    assert hb.path is None and manifest_path("x") == "x.manifest.json"

@@ -78,6 +78,17 @@ def test_registry_enumerates_every_program_family(clean_report):
     assert len(names) >= 30
 
 
+def test_registry_count_pinned_exactly(clean_report):
+    # the ISSUE-17 no-new-compiled-programs gate: the telemetry plane is
+    # host-side only, so the cached-program count is pinned EXACTLY — any
+    # drift (either direction) is a deliberate registry change that must
+    # update lint.REGISTRY_PROGRAMS in the same commit
+    from madraft_tpu.tpusim.lint import REGISTRY_PROGRAMS
+
+    assert REGISTRY_PROGRAMS == 31
+    assert len(clean_report["programs"]) == REGISTRY_PROGRAMS
+
+
 def test_declared_exceptions_are_counted_not_flagged(clean_report):
     # harvest's cross-lane reductions and the coverage bitmap scatter are
     # DECLARED hits: they must show up in the allowed counts (proof the
