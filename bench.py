@@ -556,6 +556,68 @@ def bench_latency(n_clusters: int, n_ticks: int) -> dict:
     }
 
 
+def bench_profile_gates(seed: int = 12345) -> dict:
+    """Per-profile game-day gate table (ISSUE 19) — the generalization of
+    the single storm `tail_gate`: every storm_profiles() name runs ONE
+    clean-algorithm leg at its gate's `bench_scale` with the metrics plane
+    on, and the verdict row checks three facts against
+    config.profile_gates() (the one source of truth shared with ci.sh's
+    gray smoke, `--list-profiles`, and the README table): zero safety
+    violations, liveness (acked ops per lane = latency-histogram mass /
+    lanes) >= the floor, and p99 submit->ack ticks <= the ceiling.
+    Profiles carrying a `workload` entry run as kv-clerk legs so the
+    open-loop arrival + Zipf hot-key knobs actually shape the traffic the
+    gate measures. Legs are run once (SLO gate, not a throughput row); the
+    raft legs share compiled programs across profiles of equal static
+    shape, so the table costs a few compiles, not ten."""
+    from madraft_tpu.tpusim.config import profile_gates, storm_profiles
+    from madraft_tpu.tpusim.kv import KvConfig, kv_fuzz
+    from madraft_tpu.tpusim.metrics import latency_summary
+
+    profiles = storm_profiles()
+    rows = {}
+    ok = True
+    t0 = time.perf_counter()
+    for name, g in profile_gates().items():
+        cfg = profiles[name][0].replace(metrics=True)
+        lanes, ticks = g["bench_scale"]
+        if g["workload"]:
+            rep = kv_fuzz(
+                cfg.replace(p_client_cmd=0.0, compact_at_commit=False),
+                KvConfig(p_get=0.3, p_put=0.2, **g["workload"]),
+                seed, lanes, ticks,
+            )
+        else:
+            rep = report(make_chunked_fuzz_fn(cfg, lanes, ticks)(seed))
+        lat = latency_summary(rep.lat_hist.sum(axis=0))
+        liveness = round(lat["ops"] / lanes, 2)
+        p99 = lat["p99_ticks"]
+        viol = rep.n_violating
+        row_pass = bool(
+            viol == 0
+            and liveness >= g["liveness_floor"]
+            and p99 is not None and p99 <= g["p99_ceiling"]
+        )
+        ok = ok and row_pass
+        rows[name] = {
+            "n_clusters": lanes,
+            "n_ticks": ticks,
+            "violating_lanes": viol,
+            "liveness_ops_per_lane": liveness,
+            "liveness_floor": g["liveness_floor"],
+            "p99_ticks": p99,
+            "p99_ceiling": g["p99_ceiling"],
+            "bridge": g["bridge"],
+            **({"workload": g["workload"]} if g["workload"] else {}),
+            "pass": row_pass,
+        }
+    return {
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "profiles": rows,
+        "pass": ok,
+    }
+
+
 def bench_tail_attrib(n_clusters: int, n_ticks: int) -> dict:
     """Tail-latency attribution A/B (ISSUE 12): two kv-clerk legs whose
     fault axes stress DIFFERENT phases, with the dominant phase (largest
@@ -944,6 +1006,11 @@ def main() -> None:
     # tail-attribution A/B (ISSUE 12): fixed scale on purpose — the pinned
     # dominant-phase assertions were measured at this shape across seeds
     tail_attrib = bench_tail_attrib(64, 600)
+    # per-profile game-day gate table (ISSUE 19): every storm_profiles()
+    # name, clean algorithm, liveness floor + p99 ceiling from
+    # config.profile_gates() — the per-profile generalization of tail_gate;
+    # fixed per-profile scale on purpose (the floors were measured there)
+    pgates = bench_profile_gates()
     kv = bench_kv(max(256, n_clusters // 4), max(256, n_ticks // 2))
     # //4 like kv: 512 clusters under-fill the chip for this layer
     # (2.2M steps/s at 512 vs 3.4M at 1024, measured in the r03d soak)
@@ -1046,6 +1113,9 @@ def main() -> None:
                     # phase-attribution A/B + dominant-phase pin (ISSUE 12)
                     "tail_attrib_pass": tail_attrib["pass"],
                     "tail_attrib": tail_attrib,
+                    # per-profile liveness/p99 gate table (ISSUE 19)
+                    "profile_gates_pass": pgates["pass"],
+                    "profile_gates": pgates,
                     "device": str(jax.devices()[0]),
                     **({"degraded": degraded} if degraded else {}),
                 },

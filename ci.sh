@@ -341,6 +341,71 @@ print(f"service packed smoke: kv {kv_tele['bytes_per_lane']} B/lane, "
       f"shardkv {tele['bytes_per_lane']} B/deployment, all legs packed")
 PY
 
+# gray-failure game-day smoke (ISSUE 19): the gray profiles through the
+# pool CLI. Clean legs on `limp` (limping senders) and `fsync_stall` (the
+# widest ack_before_fsync window any profile offers) must stay violation-
+# free AND live — the per-profile liveness floor and p99 ceiling come from
+# config.profile_gates(), the same source bench's gate table enforces —
+# and the heartbeat manifest must echo the active profile name (the
+# ISSUE 19 additive field; MIGRATION.md). The planted-bug leg re-arms
+# ack_before_fsync under the stall profile: the durability oracles must
+# fire (exit 1) — the stall axis exists to widen exactly that window.
+MADTPU_PLATFORM=cpu python - <<'PY'
+import contextlib, io, json, os, tempfile
+from madraft_tpu.__main__ import main
+from madraft_tpu.tpusim.config import profile_gates
+from madraft_tpu.tpusim.telemetry import manifest_path
+
+def run(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(argv)
+    return rc, [json.loads(x) for x in buf.getvalue().strip().splitlines()]
+
+gates = profile_gates()
+d = tempfile.mkdtemp()
+floors = {}
+for prof in ("limp", "fsync_stall"):
+    hb = os.path.join(d, f"ci_gray_{prof}.jsonl")
+    rc, lines = run(["pool", "--profile", prof, "--clusters", "64",
+                     "--ticks", "300", "--chunk-ticks", "100",
+                     "--budget-ticks", "300", "--seed", "12345",
+                     "--metrics", "--heartbeat", hb])
+    s = lines[-1]
+    assert rc == 0, f"gray clean leg [{prof}] exit {rc} != 0"
+    assert s["retired_violating"] == 0 and s["retired"] == 64, s
+    assert s["state_layout"] == "packed", s
+    g = gates[prof]
+    lat = s["latency"]
+    ops_per_lane = lat["ops"] / 64
+    assert ops_per_lane >= g["liveness_floor"], (
+        f"[{prof}] liveness floor breach: {ops_per_lane:.2f} ops/lane < "
+        f"{g['liveness_floor']} — the gray axis starved the cluster"
+    )
+    assert lat["p99_ticks"] <= g["p99_ceiling"], (
+        f"[{prof}] p99 ceiling breach: {lat['p99_ticks']} > "
+        f"{g['p99_ceiling']} ticks"
+    )
+    ctx = json.load(open(manifest_path(hb)))["context"]
+    assert ctx["profile"] == prof, ctx.get("profile")
+    floors[prof] = (round(ops_per_lane, 2), lat["p99_ticks"])
+
+rc, lines = run(["pool", "--profile", "fsync_stall", "--bug",
+                 "ack_before_fsync", "--clusters", "64", "--ticks", "300",
+                 "--chunk-ticks", "100", "--budget-ticks", "600",
+                 "--seed", "1"])
+s = lines[-1]
+assert rc == 1, f"gray bug leg exit {rc} != 1"
+assert s["retired_violating"] >= 1, (
+    "fsync_stall failed to surface ack_before_fsync — the stall axis no "
+    "longer widens the volatile window"
+)
+print("gray smoke: clean legs " + ", ".join(
+    f"{p} {o} ops/lane p99={q}" for p, (o, q) in floors.items())
+    + f" within gates; stall bug leg retired {s['retired_violating']} "
+    "violating, manifest echoes profile")
+PY
+
 # sharded-pool smoke (ISSUE 7): the pod-scale lane-partitioned pool on the
 # 2-virtual-device CI config. The planted-bug leg must retire >= 1 violating
 # cluster and exit 1; the clean leg must retire everything at the horizon
@@ -414,7 +479,21 @@ echo "== [6/6] bench smoke (1024 clusters x 128 ticks)"
 # per-round trajectory (BENCH_r01..) stays machine-readable instead of
 # living only in PERF.md prose; the smoke here deliberately does NOT write
 # an artifact (smoke scale is not a round).
-timeout 600 python bench.py 1024 128 \
-  || MADTPU_BENCH_PLATFORM=cpu timeout 600 python bench.py 1024 128
+{ timeout 900 python bench.py 1024 128 \
+  || MADTPU_BENCH_PLATFORM=cpu timeout 900 python bench.py 1024 128; } \
+  | tee bench_smoke.out
+# per-profile gate table (ISSUE 19): every storm_profiles() name must hold
+# its clean-algorithm liveness floor + p99 ceiling (config.profile_gates(),
+# the same table the gray smoke above checks two rows of) — a failing row
+# names the profile and which side (liveness/p99/violations) breached.
+python - <<'PY'
+import json
+doc = json.loads(open("bench_smoke.out").read().strip().splitlines()[-1])
+pg = doc["detail"]["profile_gates"]
+bad = {n: r for n, r in pg["profiles"].items() if not r["pass"]}
+assert doc["detail"]["profile_gates_pass"], f"profile gate breach: {bad}"
+print(f"profile gate table: {len(pg['profiles'])} profiles green "
+      f"in {pg['wall_s']}s")
+PY
 
 echo "CI GREEN"

@@ -106,6 +106,42 @@ def _sim_config(args):
     return cfg
 
 
+def cmd_list_profiles(args=None) -> int:
+    """``--list-profiles`` (ISSUE 19): print the scenario registry — one
+    row per named profile with its knob deltas from the profile's own
+    n_nodes default, the demonstrated scale, the clean-algorithm liveness
+    floor, the p99 ceiling, and the C++-bridge support — and exit 0.
+    Host-side only (runs before backend init, like stats)."""
+    import dataclasses
+
+    from madraft_tpu.tpusim import SimConfig
+    from madraft_tpu.tpusim.config import profile_gates, storm_profiles
+
+    gates = profile_gates()
+    print(f"{'profile':18s} {'floor>=':>8s} {'p99<=':>6s} {'scale':>10s} "
+          f"{'bridge':11s} knobs")
+    for name, (cfg, rec_clusters, rec_ticks, bugs) in storm_profiles().items():
+        base = dataclasses.asdict(SimConfig(n_nodes=cfg.n_nodes))
+        cur = dataclasses.asdict(cfg)
+        delta = " ".join(
+            f"{k}={v}" for k, v in cur.items() if v != base[k]
+        ) or "(defaults)"
+        if cfg.n_nodes != 5:
+            delta = f"n_nodes={cfg.n_nodes} " + delta
+        g = gates[name]
+        wl = g.get("workload") or {}
+        if wl:
+            delta += " | workload: " + " ".join(
+                f"{k}={v}" for k, v in wl.items()
+            )
+        if bugs:
+            delta += " | demonstrates: " + ",".join(bugs)
+        print(f"{name:18s} {g['liveness_floor']:>8g} {g['p99_ceiling']:>6d} "
+              f"{rec_clusters:>5d}x{rec_ticks:<4d} {g['bridge']:11s} "
+              f"{delta}")
+    return 0
+
+
 def _knobs_json(verb: str, raw: str):
     """``--knobs-json`` value -> dict (or None when absent), with clean CLI
     errors at exit code 2 (the argparse usage-error convention) so a bad
@@ -387,6 +423,7 @@ def cmd_pool(args):
         chunk_ticks=args.chunk_ticks, budget_ticks=budget_ticks,
         budget_seconds=budget_seconds, devices=devices,
         on_retired=on_retired, coverage=ccfg, heartbeat=hb,
+        profile=getattr(args, "profile", ""),
     )
     dev = jax.devices()[0]
     summary.update(
@@ -1238,19 +1275,22 @@ def main(argv=None) -> int:
                              "and event columns (separate cached programs "
                              "— the metrics-off hot path is untouched)")
         sp.add_argument("--profile", default="",
-                        choices=["", "storm", "fig8", "revote", "durability"],
-                        help="tuned fault-storm preset (overrides --nodes "
-                             "and --storm); the scale each bug "
-                             "was demonstrated at: --profile fig8 --bug "
-                             "commit_any_term --clusters 1024 --ticks 1000; "
-                             "--profile revote --bug forget_voted_for "
-                             "--clusters 2048 --ticks 1000; --profile storm "
-                             "--bug grant_any_vote|no_truncate "
-                             "--clusters 256 --ticks 600; --profile "
-                             "durability --bug ack_before_fsync "
-                             "--clusters 256 --ticks 600 (crash storm with "
-                             "fsync_every=8, p_lose_unsynced=1.0 — the "
-                             "lossy-persistence axis)")
+                        help="named scenario from config.storm_profiles() — "
+                             "the planted-bug storms (storm | fig8 | revote "
+                             "| durability) plus the ISSUE-19 gray-failure "
+                             "game days (limp | skew_storm | fsync_stall | "
+                             "rolling_wave | hot_key_openloop | gray_storm). "
+                             "A profile owns topology and fault knobs "
+                             "(overrides --nodes and --storm); see "
+                             "--list-profiles for the full table with each "
+                             "profile's liveness floor and p99 ceiling "
+                             "(an unknown name exits 2 listing the "
+                             "available ones)")
+        sp.add_argument("--list-profiles", action="store_true",
+                        help="print the scenario registry — every profile's "
+                             "knob deltas, demonstrated scale, liveness "
+                             "floor, p99 ceiling, and C++-bridge support — "
+                             "and exit 0 (host-side: no backend init)")
 
     def fuzz_common(sp, clusters):
         common(sp, clusters)
@@ -1504,6 +1544,24 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)
+    if getattr(args, "list_profiles", False):
+        # the scenario registry is pure config (ISSUE 19) — print it and
+        # exit 0 without touching any backend
+        return cmd_list_profiles(args)
+    prof = getattr(args, "profile", "")
+    if prof:
+        # dynamic validation (the registry is the source of truth, not an
+        # argparse choices list): unknown names exit 2 per the PR-6
+        # usage-error convention, listing what IS available
+        from madraft_tpu.tpusim.config import storm_profiles
+
+        names = list(storm_profiles())
+        if prof not in names:
+            print(
+                f"madtpu: unknown --profile {prof!r}; available: "
+                + " ".join(names), file=sys.stderr,
+            )
+            return 2
     if args.cmd == "stats" or (args.cmd == "explain"
                                and getattr(args, "heartbeat", "")):
         # pure host-side renderers (stats; explain over a heartbeat

@@ -428,7 +428,8 @@ def _hb_context(cfg: SimConfig, seed: int, n_clusters: int, horizon: int,
                 chunk_ticks: int, devices: Optional[int],
                 budget_ticks: Optional[int],
                 budget_seconds: Optional[float],
-                coverage: Optional[CoverageConfig] = None) -> dict:
+                coverage: Optional[CoverageConfig] = None,
+                profile: str = "") -> dict:
     """The manifest's config echo (ISSUE 17): enough for a watcher to know
     WHAT is running (and for budget_frac/ETA) without the launching shell.
     ``static_key`` is the compiled-program identity — two manifests with
@@ -447,6 +448,10 @@ def _hb_context(cfg: SimConfig, seed: int, n_clusters: int, horizon: int,
     }
     if coverage is not None:
         ctx["coverage"] = dataclasses.asdict(coverage)
+    if profile:
+        # ISSUE 19: the active game-day scenario name (schema-compatible
+        # additive field — absent on unnamed-knob runs; MIGRATION.md)
+        ctx["profile"] = profile
     return ctx
 
 
@@ -1119,6 +1124,7 @@ def run_pool(
     coverage: Optional[CoverageConfig] = None,
     pack_states: Optional[bool] = None,
     heartbeat=None,
+    profile: str = "",
 ) -> dict:
     """Continuous fuzzing pool: chunk -> harvest -> refill until the budget
     is spent. ``n_clusters`` lanes stay resident on device; a lane retires
@@ -1190,12 +1196,13 @@ def run_pool(
             chunk_ticks=chunk_ticks, budget_ticks=budget_ticks,
             budget_seconds=budget_seconds, mesh=mesh, devices=devices,
             on_retired=on_retired, pack_states=pack_states,
-            heartbeat=heartbeat,
+            heartbeat=heartbeat, profile=profile,
         )
     hb = _telemetry.as_writer(heartbeat)
     if hb is not None:
         hb.open(_hb_context(cfg, seed, n_clusters, horizon, chunk_ticks,
-                            devices, budget_ticks, budget_seconds))
+                            devices, budget_ticks, budget_seconds,
+                            profile=profile))
     static = cfg.static_key()
     kn = cfg.knobs()
     packed, layout = _choose_layout(cfg, kn, horizon + chunk_ticks,
@@ -1506,6 +1513,7 @@ def _run_pool_coverage(
     on_retired,
     pack_states: Optional[bool] = None,
     heartbeat=None,
+    profile: str = "",
 ) -> dict:
     """run_pool's coverage-guided body (see run_pool for the contract).
 
@@ -1530,7 +1538,7 @@ def _run_pool_coverage(
     if hb is not None:
         hb.open(_hb_context(cfg, seed, n_clusters, horizon, chunk_ticks,
                             devices, budget_ticks, budget_seconds,
-                            coverage=ccfg))
+                            coverage=ccfg, profile=profile))
     static = cfg.static_key()
     base_kn = cfg.knobs()
     packed, layout = _choose_layout(cfg, base_kn, horizon + chunk_ticks,
@@ -1673,6 +1681,21 @@ def _validate_knobs(knobs) -> None:
         raise ValueError("majority and heartbeat_ticks must be >= 1")
     if (k.flow_cap < 1).any() or (k.compact_every < 1).any():
         raise ValueError("flow_cap and compact_every must be >= 1")
+    # gray-failure knobs (ISSUE 19)
+    validate_probs(k, ("p_limp", "p_limp_heal", "p_fsync_stall"), "raft")
+    if (k.limp_mult_max < 1).any():
+        raise ValueError(
+            f"limp_mult_max must be >= 1 (1 = limping off): {k.limp_mult_max}"
+        )
+    if (k.eto_skew < 0).any() or (k.fsync_stall_ticks < 0).any():
+        raise ValueError("eto_skew and fsync_stall_ticks must be >= 0")
+    if (k.rolling_period < 0).any() or (k.rolling_down < 0).any():
+        raise ValueError("rolling_period and rolling_down must be >= 0")
+    if ((k.rolling_period > 0) & (k.rolling_down >= k.rolling_period)).any():
+        raise ValueError(
+            "rolling_down must stay < rolling_period (a wave's node must "
+            "come back up before the next wave starts)"
+        )
 
 
 def validate_probs(k, names, layer: str) -> None:

@@ -48,9 +48,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from madraft_tpu.tpusim.config import (
     LEADER,
     NOOP_CMD,
+    OPEN_QUEUE_SLOTS,
     SimConfig,
     metrics_dims,
     packed_bounds,
+    zipf_map,
 )
 from madraft_tpu.tpusim.engine import (
     FuzzProgram,
@@ -150,6 +152,26 @@ class KvConfig:
     #                               caught as a measured liveness collapse
     #                               vs random routing (tests), not a safety
     #                               oracle: hints only steer routing
+    # --- open-loop traffic shape (ISSUE 19; all dynamic knobs) ---
+    open_rate: float = 0.0   # offered load: per-clerk per-tick arrival
+    #                          probability (Bernoulli-per-tick ~ Poisson at
+    #                          small rates). Arrivals queue regardless of
+    #                          whether the clerk is busy — the OPEN-loop
+    #                          regime where queues and tails blow up; the
+    #                          submit stamp is the ARRIVAL tick, so queue
+    #                          wait lands in lat_hist and the leader_wait
+    #                          phase. Harvested from the free low 9 bits of
+    #                          the p_op start word: zero extra PRNG draws.
+    open_queue_cap: int = 0  # bounded pending queue per clerk (arrivals
+    #                          past it DROP and are counted); 0 = the
+    #                          historic closed-loop clerk, which is also
+    #                          the neutral bit-identity value. Capped at
+    #                          config.OPEN_QUEUE_SLOTS (the stamp ring).
+    zipf_a: float = 1.0      # hot-key skew exponent on the fresh-op key
+    #                          draw (config.zipf_map): 1.0 = the historic
+    #                          uniform draw bit-identically; larger values
+    #                          concentrate traffic on low-numbered keys,
+    #                          feeding the per-key attribution axis
 
     def __post_init__(self):
         if self.p_get + self.p_put > 1.0:
@@ -158,6 +180,17 @@ class KvConfig:
                 "(one uniform draw splits Get/Put/Append; an over-unity pair "
                 "would silently starve Appends)"
             )
+        if not 0.0 <= self.open_rate <= 1.0:
+            raise ValueError(f"open_rate {self.open_rate} not in [0, 1] "
+                             "(per-tick arrival probability)")
+        if not 0 <= self.open_queue_cap <= OPEN_QUEUE_SLOTS:
+            raise ValueError(
+                f"open_queue_cap {self.open_queue_cap} not in "
+                f"[0, {OPEN_QUEUE_SLOTS}] (the arrival-stamp ring size)"
+            )
+        if self.zipf_a < 1.0:
+            raise ValueError(f"zipf_a {self.zipf_a} must be >= 1.0 "
+                             "(1.0 = uniform)")
         # every packed op must stay below NOOP_CMD (the leader no-op
         # sentinel) or a real client op would be skipped as a no-op forever
         # (silent clerk livelock) — and below i32
@@ -183,6 +216,9 @@ class KvConfig:
             bug_apply_uncommitted=jnp.bool_(self.bug_apply_uncommitted),
             bug_stale_read=jnp.bool_(self.bug_stale_read),
             bug_stale_hint=jnp.bool_(self.bug_stale_hint),
+            open_rate=jnp.float32(self.open_rate),
+            open_queue_cap=jnp.int32(self.open_queue_cap),
+            zipf_a=jnp.float32(self.zipf_a),
         )
 
     def static_key(self) -> "KvConfig":
@@ -206,6 +242,9 @@ class KvKnobs(NamedTuple):
     bug_apply_uncommitted: jax.Array
     bug_stale_read: jax.Array
     bug_stale_hint: jax.Array
+    open_rate: jax.Array
+    open_queue_cap: jax.Array
+    zipf_a: jax.Array
 
     def broadcast(self, n_clusters: int) -> "KvKnobs":
         return KvKnobs(*(jnp.broadcast_to(x, (n_clusters,)) for x in self))
@@ -225,6 +264,16 @@ class KvState(NamedTuple):
     #                          reference ClerkCore's leader_ cache, fed by
     #                          NotLeader{hint} replies (client.rs:32-63)
     clerk_wait: jax.Array    # i32 await-reply countdown (see retry_wait)
+    # --- open-loop arrival queue (ISSUE 19; frozen at the zero init in the
+    # neutral closed-loop mode). Cursor arithmetic: pending = arr - srv,
+    # the stamp ring is indexed mod OPEN_QUEUE_SLOTS, and open_queue_cap
+    # <= OPEN_QUEUE_SLOTS (validated) keeps live stamps from colliding. ---
+    open_arr: jax.Array      # i32 [NC] arrivals accepted into the queue
+    open_srv: jax.Array      # i32 [NC] arrivals started (dequeued)
+    open_drop: jax.Array     # i32 [NC] arrivals dropped at a full queue
+    open_stamp: jax.Array    # i32 [NC, OPEN_QUEUE_SLOTS] arrival-tick ring
+    #                          (metrics only; dequeue reads it as the
+    #                          submit stamp so queue wait is measured)
     clerk_sub: jax.Array     # i32 [NC] submit stamp: tick the outstanding op
     #                          STARTED (ISSUE 10 metrics; zero-size with
     #                          cfg.metrics off). At ack, t - clerk_sub folds
@@ -323,6 +372,11 @@ def init_kv_cluster(
         clerk_acked=jnp.zeros((nc,), I32),
         clerk_leader=jnp.full((nc,), -1, I32),
         clerk_wait=jnp.zeros((nc,), I32),
+        open_arr=jnp.zeros((nc,), I32),
+        open_srv=jnp.zeros((nc,), I32),
+        open_drop=jnp.zeros((nc,), I32),
+        open_stamp=jnp.zeros((nc if cfg.metrics else 0, OPEN_QUEUE_SLOTS),
+                             I32),
         clerk_sub=jnp.zeros((nc if cfg.metrics else 0,), I32),
         clerk_app=jnp.zeros((nc if cfg.metrics else 0,), I32),
         clerk_cmt=jnp.zeros((nc if cfg.metrics else 0,), I32),
@@ -624,17 +678,51 @@ def _kv_service_tick(
             client_lat_hist, e2e, newly_acked, cl_ids
         )
 
-    # start fresh ops / retry pending ones
+    # start fresh ops / retry pending ones. The p_op start word is drawn at
+    # BIT level: the uniform below reconstructs jax.random.uniform's
+    # mantissa path bit-identically (top 23 bits), which frees the low
+    # 9 bits as the open-loop arrival draw (ISSUE 19) — the gray traffic
+    # shape costs ZERO extra PRNG draws and the closed-loop start decision
+    # is unchanged to the bit.
     kk = jax.random.split(jax.random.fold_in(key, _S_CLERK_START), 4)
+    w_start = jax.random.bits(kk[0], (nc,))
+    u_start = jax.lax.bitcast_convert_type(
+        (w_start >> np.uint32(9)) | np.uint32(0x3F800000), jnp.float32
+    ) - 1.0
+    # open-loop arrivals: offered load lands in a bounded per-clerk queue
+    # whether or not the clerk is busy; past the cap it drops (and counts)
+    openloop = kkn.open_queue_cap > 0
+    arrive = openloop & (
+        (w_start & np.uint32(0x1FF)).astype(jnp.float32)
+        * jnp.float32(2.0 ** -9)
+        < kkn.open_rate
+    )
+    drop = arrive & (ks.open_arr - ks.open_srv >= kkn.open_queue_cap)
+    enq = arrive & ~drop
+    open_arr = ks.open_arr + enq.astype(I32)
+    open_drop = ks.open_drop + drop.astype(I32)
+    open_stamp = ks.open_stamp
+    if cfg.metrics:
+        slot_e = (
+            jnp.arange(OPEN_QUEUE_SLOTS, dtype=I32)[None, :]
+            == (ks.open_arr % OPEN_QUEUE_SLOTS)[:, None]
+        )
+        open_stamp = jnp.where(enq[:, None] & slot_e, t, ks.open_stamp)
     start = (
         ~clerk_out
-        & jax.random.bernoulli(kk[0], kkn.p_op, (nc,))
+        & jnp.where(openloop, open_arr > ks.open_srv, u_start < kkn.p_op)
         & (ks.clerk_seq < _SEQ_LIM - 1)
     )
+    open_srv = ks.open_srv + (openloop & start).astype(I32)
     clerk_seq = jnp.where(start, ks.clerk_seq + 1, ks.clerk_seq)
+    # hot-key skew: zipf_map is the identity at zipf_a=1.0 (the randint
+    # draw itself is unchanged either way — same draw count, same bits)
     clerk_key = jnp.where(
         start,
-        jax.random.randint(kk[1], (nc,), 0, kcfg.n_keys, dtype=I32),
+        zipf_map(
+            jax.random.randint(kk[1], (nc,), 0, kcfg.n_keys, dtype=I32),
+            kcfg.n_keys, kkn.zipf_a,
+        ),
         ks.clerk_key,
     )
     u_kind = jax.random.uniform(jax.random.fold_in(key, _S_CLERK_KIND), (nc,))
@@ -657,11 +745,21 @@ def _kv_service_tick(
     clerk_sub = ks.clerk_sub
     clerk_app = ks.clerk_app
     if cfg.metrics:
-        # submit stamp: a fresh op's latency window opens NOW (an op never
-        # acks in its start tick — the serve path below requires ~start and
-        # the shadow ack needs a commit, which takes at least one tick);
-        # the phase boundary stamps reset with it
-        clerk_sub = jnp.where(start, t, clerk_sub)
+        # submit stamp: a fresh op's latency window opens NOW — except in
+        # the open-loop regime, where it opens at the op's ARRIVAL tick
+        # (read from the stamp ring at the dequeue cursor; a same-tick
+        # arrive->start reads the stamp just written, i.e. t), so the queue
+        # wait is inside the measured window and lands in the leader_wait
+        # phase. (An op never acks in its start tick — the serve path below
+        # requires ~start and the shadow ack needs a commit.) The phase
+        # boundary stamps reset with it.
+        slot_d = (
+            jnp.arange(OPEN_QUEUE_SLOTS, dtype=I32)[None, :]
+            == (ks.open_srv % OPEN_QUEUE_SLOTS)[:, None]
+        )
+        arr_t = jnp.sum(jnp.where(slot_d, open_stamp, 0), axis=1)
+        clerk_sub = jnp.where(start, jnp.where(openloop, arr_t, t),
+                              clerk_sub)
         clerk_app = jnp.where(start, 0, clerk_app)
         clerk_cmt = jnp.where(start, 0, clerk_cmt)
         clerk_apl = jnp.where(start, 0, clerk_apl)
@@ -862,6 +960,10 @@ def _kv_service_tick(
         clerk_acked=clerk_acked,
         clerk_leader=clerk_leader,
         clerk_wait=clerk_wait,
+        open_arr=open_arr,
+        open_srv=open_srv,
+        open_drop=open_drop,
+        open_stamp=open_stamp,
         clerk_sub=clerk_sub,
         clerk_app=clerk_app,
         clerk_cmt=clerk_cmt,
@@ -945,6 +1047,10 @@ def kv_packed_layout(cfg: SimConfig, kcfg: KvConfig) -> tuple:
         "clerk_acked": seq,
         "clerk_leader": jnp.int8,      # node id, -1 sentinel (n_nodes <= 16)
         "clerk_wait": sp.tick,         # retry_wait gated <= b.tick
+        "open_arr": sp.tick,           # <= 1 arrival per clerk per tick
+        "open_srv": sp.tick,           # <= open_arr
+        "open_drop": sp.tick,          # <= arrivals
+        "open_stamp": sp.tick,         # absolute arrival ticks
         "clerk_sub": sp.tick,
         "clerk_app": sp.tick,          # phase boundary stamps (ISSUE 12)
         "clerk_cmt": sp.tick,
@@ -985,6 +1091,10 @@ class PackedKvState(NamedTuple):
     clerk_acked: jax.Array
     clerk_leader: jax.Array
     clerk_wait: jax.Array
+    open_arr: jax.Array
+    open_srv: jax.Array
+    open_drop: jax.Array
+    open_stamp: jax.Array
     clerk_sub: jax.Array
     clerk_app: jax.Array
     clerk_cmt: jax.Array
@@ -1221,12 +1331,23 @@ def _validate_kv_knobs(kkn) -> None:
 
     k = jax.tree.map(np.asarray, kkn)
     validate_probs(
-        k, ("p_op", "p_get", "p_put", "p_retry", "p_follow_hint"), "kv"
+        k, ("p_op", "p_get", "p_put", "p_retry", "p_follow_hint",
+            "open_rate"), "kv"
     )
     if (k.p_get + k.p_put > 1.0).any():
         raise ValueError(
             "p_get + p_put must stay <= 1 per cluster (one uniform draw "
             "splits Get/Put/Append)"
+        )
+    if ((k.open_queue_cap < 0) | (k.open_queue_cap > OPEN_QUEUE_SLOTS)).any():
+        raise ValueError(
+            f"open_queue_cap must stay in [0, {OPEN_QUEUE_SLOTS}] (the "
+            "arrival-stamp ring size; 0 = closed loop)"
+        )
+    if (k.zipf_a < 1.0).any():
+        raise ValueError(
+            "zipf_a must be >= 1.0 (1.0 = the uniform key draw; larger "
+            "values skew toward key 0)"
         )
     validate_bool_bugs(
         k, ("bug_skip_dedup", "bug_apply_uncommitted", "bug_stale_read",

@@ -77,10 +77,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from madraft_tpu.tpusim.config import (
     LEADER,
     NOOP_CMD,
+    OPEN_QUEUE_SLOTS,
     SimConfig,
     metrics_dims,
     SHARDKV_PHASES,
     packed_bounds,
+    zipf_map,
 )
 from madraft_tpu.tpusim.ctrler import _rebalance as _ctrl_rebalance
 from madraft_tpu.tpusim.engine import (
@@ -243,12 +245,28 @@ class ShardKvConfig:
     #                                  of its committed prefix — a group can
     #                                  adopt a phantom announce that raft
     #                                  later rolls back; CTRL_STALE must fire
+    # --- open-loop traffic shape (ISSUE 19; dynamic — kv.py semantics:
+    # Bernoulli-per-tick arrivals into a bounded per-clerk queue, submit
+    # stamp = arrival tick, zipf_a skews the fresh-op SHARD draw) ---
+    open_rate: float = 0.0
+    open_queue_cap: int = 0     # 0 = the historic closed-loop clerk
+    zipf_a: float = 1.0         # 1.0 = the historic uniform shard draw
 
     def __post_init__(self):
         if self.p_get + self.p_put > 1.0:
             raise ValueError(
                 f"p_get ({self.p_get}) + p_put ({self.p_put}) must stay <= 1"
             )
+        if not 0.0 <= self.open_rate <= 1.0:
+            raise ValueError(f"open_rate {self.open_rate} not in [0, 1]")
+        if not 0 <= self.open_queue_cap <= OPEN_QUEUE_SLOTS:
+            raise ValueError(
+                f"open_queue_cap {self.open_queue_cap} not in "
+                f"[0, {OPEN_QUEUE_SLOTS}] (the arrival-stamp ring size)"
+            )
+        if self.zipf_a < 1.0:
+            raise ValueError(f"zipf_a {self.zipf_a} must be >= 1.0 "
+                             "(1.0 = uniform)")
         if self.computed_ctrler and self.live_ctrler:
             raise ValueError(
                 "computed_ctrler supersedes live_ctrler — enable one"
@@ -318,6 +336,9 @@ class ShardKvConfig:
             bug_stale_ctrler_read=jnp.bool_(self.bug_stale_ctrler_read),
             bug_rotate_tiebreak=jnp.bool_(self.bug_rotate_tiebreak),
             requery_wrong_group=jnp.bool_(self.requery_wrong_group),
+            open_rate=jnp.float32(self.open_rate),
+            open_queue_cap=jnp.int32(self.open_queue_cap),
+            zipf_a=jnp.float32(self.zipf_a),
         )
 
     def static_key(self) -> "ShardKvConfig":
@@ -359,6 +380,9 @@ class ShardKvKnobs(NamedTuple):
     bug_stale_ctrler_read: jax.Array
     bug_rotate_tiebreak: jax.Array
     requery_wrong_group: jax.Array
+    open_rate: jax.Array
+    open_queue_cap: jax.Array
+    zipf_a: jax.Array
 
     def broadcast(self, n_clusters: int) -> "ShardKvKnobs":
         return ShardKvKnobs(
@@ -506,6 +530,14 @@ class ShardKvState(NamedTuple):
     clerk_get_lo: jax.Array       # i32 [NC] truth_count[shard] at invoke
     clerk_get_obs: jax.Array      # i32 [NC] observed count; -1 = no reply yet
     gets_done: jax.Array          # i32 [NC] completed Gets
+    # --- open-loop arrival queue (ISSUE 19; kv.py semantics: pending =
+    # arr - srv, stamp ring mod OPEN_QUEUE_SLOTS, frozen at zero in the
+    # neutral closed-loop mode) ---
+    open_arr: jax.Array           # i32 [NC] arrivals accepted
+    open_srv: jax.Array           # i32 [NC] arrivals started
+    open_drop: jax.Array          # i32 [NC] arrivals dropped at a full queue
+    open_stamp: jax.Array         # i32 [NC, OPEN_QUEUE_SLOTS] arrival ticks
+    #                               (metrics only)
     # --- metrics plane (ISSUE 10; zero-size with cfg.metrics off) ---
     clerk_sub: jax.Array          # i32 [NC] submit stamp: tick the
     #                               outstanding op started (kv.py clerk_sub)
@@ -777,6 +809,11 @@ def init_shardkv_cluster(
         clerk_get_lo=jnp.zeros((nc,), I32),
         clerk_get_obs=jnp.full((nc,), -1, I32),
         gets_done=jnp.zeros((nc,), I32),
+        open_arr=jnp.zeros((nc,), I32),
+        open_srv=jnp.zeros((nc,), I32),
+        open_drop=jnp.zeros((nc,), I32),
+        open_stamp=jnp.zeros((nc if cfg.metrics else 0, OPEN_QUEUE_SLOTS),
+                             I32),
         clerk_sub=jnp.zeros((nc if cfg.metrics else 0,), I32),
         lat_hist=jnp.zeros(metrics_dims(cfg)[:1], I32),
         clerk_app=jnp.zeros((nc if cfg.metrics else 0,), I32),
@@ -1800,14 +1837,44 @@ def _shardkv_service_tick(
     clerk_cfg = jnp.where(
         learn, active_cfg, st.clerk_cfg
     )
+    # The p_op start word is drawn at BIT level (kv.py's clerk): the
+    # uniform reconstruction below matches jax.random.bernoulli's mantissa
+    # path bit-identically, and the free low 9 bits are the open-loop
+    # arrival draw (ISSUE 19) — zero extra PRNG draws either way.
+    w_start = jax.random.bits(kc[1], (nc,))
+    u_start = jax.lax.bitcast_convert_type(
+        (w_start >> np.uint32(9)) | np.uint32(0x3F800000), jnp.float32
+    ) - 1.0
+    openloop = skn.open_queue_cap > 0
+    arrive = openloop & (
+        (w_start & np.uint32(0x1FF)).astype(jnp.float32)
+        * jnp.float32(2.0 ** -9)
+        < skn.open_rate
+    )
+    drop = arrive & (st.open_arr - st.open_srv >= skn.open_queue_cap)
+    enq = arrive & ~drop
+    open_arr = st.open_arr + enq.astype(I32)
+    open_drop = st.open_drop + drop.astype(I32)
+    open_stamp = st.open_stamp
+    if cfg.metrics:
+        slot_e = (
+            jnp.arange(OPEN_QUEUE_SLOTS, dtype=I32)[None, :]
+            == (st.open_arr % OPEN_QUEUE_SLOTS)[:, None]
+        )
+        open_stamp = jnp.where(enq[:, None] & slot_e, t, st.open_stamp)
     start = (
         ~clerk_out
-        & jax.random.bernoulli(kc[1], skn.p_op, (nc,))
+        & jnp.where(openloop, open_arr > st.open_srv, u_start < skn.p_op)
         & (st.clerk_seq < _SEQ_LIM - 1)
     )
+    open_srv = st.open_srv + (openloop & start).astype(I32)
     clerk_seq = jnp.where(start, st.clerk_seq + 1, st.clerk_seq)
+    # hot-shard skew: zipf_map is the identity at zipf_a=1.0 (the randint
+    # draw itself is unchanged either way)
     clerk_shard = jnp.where(
-        start, jax.random.randint(kc[2], (nc,), 0, ns, dtype=I32),
+        start,
+        zipf_map(jax.random.randint(kc[2], (nc,), 0, ns, dtype=I32),
+                 ns, skn.zipf_a),
         st.clerk_shard,
     )
     u_kind = jax.random.uniform(kc[5], (nc,))
@@ -1828,7 +1895,16 @@ def _shardkv_service_tick(
     clerk_sub = st.clerk_sub
     clerk_app, clerk_mig = st.clerk_app, st.clerk_mig
     if cfg.metrics:
-        clerk_sub = jnp.where(start, t, clerk_sub)  # submit stamp
+        # submit stamp: open-loop dequeues read the op's ARRIVAL tick from
+        # the stamp ring (same-tick arrive->start reads the stamp just
+        # written, i.e. t) so queue wait is measured; closed loop stamps NOW
+        slot_d = (
+            jnp.arange(OPEN_QUEUE_SLOTS, dtype=I32)[None, :]
+            == (st.open_srv % OPEN_QUEUE_SLOTS)[:, None]
+        )
+        arr_t = jnp.sum(jnp.where(slot_d, open_stamp, 0), axis=1)
+        clerk_sub = jnp.where(start, jnp.where(openloop, arr_t, t),
+                              clerk_sub)
         clerk_app = jnp.where(start, 0, clerk_app)
         clerk_cmt = jnp.where(start, 0, clerk_cmt)
         clerk_apl = jnp.where(start, 0, clerk_apl)
@@ -2022,6 +2098,8 @@ def _shardkv_service_tick(
         clerk_wrong=clerk_wrong, clerk_acked=clerk_acked,
         clerk_get_lo=clerk_get_lo, clerk_get_obs=clerk_get_obs,
         gets_done=gets_done,
+        open_arr=open_arr, open_srv=open_srv, open_drop=open_drop,
+        open_stamp=open_stamp,
         clerk_sub=clerk_sub, lat_hist=lat_hist,
         clerk_app=clerk_app, clerk_cmt=clerk_cmt, clerk_apl=clerk_apl,
         clerk_mig=clerk_mig, client_retries=client_retries,
@@ -2150,6 +2228,10 @@ def shardkv_packed_layout(cfg: SimConfig, kcfg: ShardKvConfig) -> tuple:
         "clerk_get_lo": cnt,
         "clerk_get_obs": obs,
         "gets_done": sp.tick,
+        "open_arr": sp.tick,         # <= 1 arrival per clerk per tick
+        "open_srv": sp.tick,
+        "open_drop": sp.tick,
+        "open_stamp": sp.tick,       # absolute arrival ticks
         "clerk_sub": sp.tick,
         "lat_hist": cnt,             # acked ops are distinct (client, seq)
         # attribution plane (ISSUE 12)
@@ -2254,6 +2336,10 @@ class PackedShardKvState(NamedTuple):
     clerk_get_lo: jax.Array
     clerk_get_obs: jax.Array
     gets_done: jax.Array
+    open_arr: jax.Array
+    open_srv: jax.Array
+    open_drop: jax.Array
+    open_stamp: jax.Array
     clerk_sub: jax.Array
     lat_hist: jax.Array
     clerk_app: jax.Array
@@ -2576,10 +2662,18 @@ def _validate_shardkv_knobs(skn) -> None:
     k = jax.tree.map(np.asarray, skn)
     validate_probs(
         k, ("p_op", "p_get", "p_put", "p_retry", "p_cfg_learn", "p_pull",
-            "p_ack", "pull_loss", "p_announce", "p_phantom"), "shardkv",
+            "p_ack", "pull_loss", "p_announce", "p_phantom", "open_rate"),
+        "shardkv",
     )
     if (k.p_get + k.p_put > 1.0).any():
         raise ValueError("p_get + p_put must stay <= 1 per deployment")
+    if ((k.open_queue_cap < 0) | (k.open_queue_cap > OPEN_QUEUE_SLOTS)).any():
+        raise ValueError(
+            f"open_queue_cap must stay in [0, {OPEN_QUEUE_SLOTS}] (the "
+            "arrival-stamp ring size; 0 = closed loop)"
+        )
+    if (k.zipf_a < 1.0).any():
+        raise ValueError("zipf_a must be >= 1.0 (1.0 = the uniform draw)")
     if (k.pull_delay_max < k.pull_delay_min).any() or (
         k.pull_delay_min < 1
     ).any():

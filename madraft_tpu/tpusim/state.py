@@ -93,6 +93,15 @@ class ClusterState(NamedTuple):
     timer: jax.Array           # i32 ticks until election timeout
     hb: jax.Array              # i32 ticks until next leader heartbeat
     alive: jax.Array           # bool
+    # --- gray-failure state (ISSUE 19; neutral values 1 / 0) ---
+    limp: jax.Array            # i32 [N] delivery-delay multiplier for this
+    #                            node's sends (1 = healthy; in
+    #                            [2, limp_mult_max] while limping; restart
+    #                            clears it — step.py faults phase)
+    fsync_stall: jax.Array     # i32 [N] remaining ticks the background
+    #                            fsync cadence is stalled (0 = none; the
+    #                            explicit persist-before-* syncs are never
+    #                            stalled — see config.p_fsync_stall)
     # --- log window [N, CAP] (persistent; slot k = absolute index base+k+1) ---
     log_term: jax.Array        # i32
     log_val: jax.Array         # i32 (commands are unique ints)
@@ -274,7 +283,9 @@ def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
     znn = jnp.zeros((n, n), I32)
     timer = jax.random.randint(
         key, (n,), kn.eto_min, kn.eto_max + 1, dtype=I32
-    )
+    ) + jnp.arange(n, dtype=I32) * jnp.asarray(kn.eto_skew, I32)
+    # (the gray clock-skew offset — adding the zero neutral knob leaves
+    # the i32 draw bit-identical, and the draw itself is unchanged)
     return ClusterState(
         tick=jnp.asarray(0, I32),
         term=zn,
@@ -283,6 +294,8 @@ def init_cluster(cfg: SimConfig, key: jax.Array, kn=None) -> ClusterState:
         timer=timer,
         hb=zn,
         alive=jnp.ones((n,), BOOL),
+        limp=jnp.ones((n,), I32),
+        fsync_stall=zn,
         log_term=jnp.zeros((n, cap), I32),
         log_val=jnp.zeros((n, cap), I32),
         log_len=zn,
@@ -446,6 +459,9 @@ class PackedClusterState(NamedTuple):
     timer: jax.Array            # u16 (eto_max gated by packed_layout_reason)
     hb: jax.Array               # u16
     alive_bits: jax.Array       # u32 scalar bitfield
+    limp: jax.Array             # u8 (limp_mult_max gated <= 255; the
+    #                             stretched delay gate keeps stamps in u8)
+    fsync_stall: jax.Array      # u16 (fsync_stall_ticks gated <= 65535)
     log_term: jax.Array
     log_val: jax.Array          # cmd dtype; NOOP_CMD -> noop_code
     log_len: jax.Array
@@ -573,6 +589,8 @@ def pack_state(cfg: SimConfig, s: ClusterState,
             jnp.where(s.alive, _bit_weights(n), jnp.asarray(0, U32)),
             dtype=U32,
         ),
+        limp=s.limp.astype(U8),
+        fsync_stall=s.fsync_stall.astype(U16),
         log_term=s.log_term.astype(sp.term),
         log_val=cmd(s.log_val),
         log_len=s.log_len.astype(sp.index),
@@ -662,6 +680,8 @@ def unpack_state(cfg: SimConfig, p: PackedClusterState,
         timer=p.timer.astype(I32),
         hb=p.hb.astype(I32),
         alive=((p.alive_bits >> idx) & 1).astype(BOOL),
+        limp=p.limp.astype(I32),
+        fsync_stall=p.fsync_stall.astype(I32),
         log_term=p.log_term.astype(I32),
         log_val=cmd(p.log_val),
         log_len=p.log_len.astype(I32),
@@ -762,10 +782,31 @@ def packed_layout_reason(cfg: SimConfig, kn, ticks_needed: int) -> Optional[str]
         # exactness gate must reject it here
         return f"delay_min {k.delay_min} < 1: a same-tick stamp would " \
                "pack as an empty mailbox slot"
-    if (k.eto_max > np.iinfo(np.uint16).max).any():
-        return f"eto_max {k.eto_max} exceeds the u16 timer field"
+    if (k.eto_max + (cfg.n_nodes - 1) * k.eto_skew
+            > np.iinfo(np.uint16).max).any():
+        return (
+            f"eto_max {k.eto_max} + (n-1) * eto_skew {k.eto_skew} exceeds "
+            "the u16 timer field"
+        )
     if (k.heartbeat_ticks > np.iinfo(np.uint16).max).any():
         return f"heartbeat_ticks {k.heartbeat_ticks} exceeds the u16 field"
+    # gray-failure fields/draws (ISSUE 19): a limping node's stretched
+    # delay must still fit the u8 relative stamp, the multiplier its u8
+    # field, and a stall spike its u16 field
+    if (k.limp_mult_max > np.iinfo(np.uint8).max).any():
+        return f"limp_mult_max {k.limp_mult_max} exceeds the u8 limp field"
+    if ((k.limp_mult_max > 1)
+            & (k.delay_max * k.limp_mult_max > b.rel_stamp - 1)).any():
+        return (
+            f"delay_max {k.delay_max} * limp_mult_max {k.limp_mult_max} "
+            f"> {b.rel_stamp - 1}: a limping node's stretched delay must "
+            "fit the u8 tick-relative mailbox stamp"
+        )
+    if (k.fsync_stall_ticks > np.iinfo(np.uint16).max).any():
+        return (
+            f"fsync_stall_ticks {k.fsync_stall_ticks} exceeds the u16 "
+            "fsync_stall field"
+        )
     return None
 
 

@@ -150,15 +150,46 @@ class _DrawBlock:
         # p may be a traced f32 scalar (dynamic knob); compare in [0,1) space
         return self._u01(self._take(shape)) < p
 
+    def bern_w(self, p, shape):
+        """bern PLUS the raw threefry words: bits 8..31 decide the draw
+        (via _u01); bits 0..7 are FREE for the caller — the _net_draws
+        packing idiom (disjoint bit ranges of one word are independent
+        draws). The gray-failure axes (ISSUE 19) harvest these low bytes,
+        so they add ZERO to the tick's PRNG budget and leave every
+        neutral-knob trajectory bit-identical."""
+        w = self._take(shape)
+        return self._u01(w) < p, w
+
     def randint(self, lo, hi, shape):  # [lo, hi); bounds may be traced i32
+        val, _ = self.randint_w(lo, hi, shape)
+        return val
+
+    def randint_w(self, lo, hi, shape):
+        """randint PLUS the raw words (low byte free — see bern_w)."""
+        w = self._take(shape)
         span = (jnp.asarray(hi, I32) - jnp.asarray(lo, I32)).astype(jnp.float32)
         # floor(u01 * span): u01 < 1.0 exactly (see _u01), so the result is
         # always in [0, span). No integer division anywhere.
         return (jnp.asarray(lo, I32)
-                + jnp.floor(self._u01(self._take(shape)) * span).astype(I32))
+                + jnp.floor(self._u01(w) * span).astype(I32)), w
 
     def uniform(self, shape):
         return self._u01(self._take(shape))
+
+
+def _bern8(words: jax.Array, p) -> jax.Array:
+    """Bernoulli(p) at 8-bit resolution from the FREE low byte of already-
+    consumed threefry words (the suffix-loss idiom in the faults phase):
+    same bias class as the _net_draws delay byte."""
+    return (words & 0xFF).astype(jnp.float32) * jnp.float32(2.0 ** -8) < p
+
+
+def _randint8(words: jax.Array, lo, span) -> jax.Array:
+    """lo + floor(low_byte/256 * span) from free low bytes: uniform over
+    [lo, lo + span - 1] for span >= 1 (multiply-shift, no division —
+    the _net_draws delay treatment). Callers gate span >= 1."""
+    s = jnp.maximum(jnp.asarray(span, I32), 0).astype(jnp.uint32)
+    return jnp.asarray(lo, I32) + (((words & 0xFF) * s) >> 8).astype(I32)
 
 
 def _block_total(n: int) -> int:
@@ -171,8 +202,12 @@ def _block_total(n: int) -> int:
     return 11 * n + 3 + 3 * n * n
 
 
-def _timeout_draw(kn, blk: "_DrawBlock", shape) -> jax.Array:
-    return blk.randint(kn.eto_min, kn.eto_max + 1, shape)
+def _timeout_draw(kn, blk: "_DrawBlock", shape, skew) -> jax.Array:
+    """Election-timeout redraw: the base [eto_min, eto_max] window plus
+    the node's persistent gray clock-skew offset (me * eto_skew; ISSUE 19
+    — 0 at the neutral knob, leaving the draw bit-identical). Every call
+    site is a per-node (n,) draw, so the offset applies elementwise."""
+    return blk.randint(kn.eto_min, kn.eto_max + 1, shape) + skew
 
 
 def _net_draws(kn, blk: "_DrawBlock", shape):
@@ -251,14 +286,38 @@ def step_cluster(
     blk = _DrawBlock(jax.random.fold_in(key, _S_STEP_BLOCK), _block_total(n))
     me = jnp.arange(n, dtype=I32)
     eye = jnp.eye(n, dtype=jnp.bool_)
+    # gray clock skew (ISSUE 19): node i's election window is offset by
+    # i * eto_skew at every timeout redraw (and at init) — 0 = neutral
+    skew = me * jnp.asarray(kn.eto_skew, I32)
 
     # ------------------------------------------------------------------ faults
-    restart = (~s.alive) & blk.bern(kn.p_restart, (n,))
-    crash_draw = s.alive & blk.bern(kn.p_crash, (n,))
+    # Rolling restart waves (ISSUE 19): a DETERMINISTIC staggered
+    # schedule, not a draw. Wave w covers ticks [w*P, (w+1)*P) and takes
+    # node (w mod n) down for its first rolling_down ticks; the node is
+    # forced back up when its window ends. rolling_period=0 leaves every
+    # mask False (neutral — and the knobs consume no PRNG words).
+    rp = jnp.maximum(jnp.asarray(kn.rolling_period, I32), 1)
+    wave = t // rp
+    wave_i = wave - ((wave - me) % n)  # node i's latest assigned wave
+    age = t - wave_i * rp              # ticks since that wave started
+    roll_on = kn.rolling_period > 0
+    roll_sched = roll_on & (wave_i >= 0)
+    roll_down = roll_sched & (age < kn.rolling_down)
+    roll_up = roll_sched & (age == kn.rolling_down)
+
+    restart_draw, w_restart = blk.bern_w(kn.p_restart, (n,))
+    # a scheduled-down node may not restart early; a wave-end node is
+    # forced up (its Bernoulli draw is overridden, not consumed extra)
+    restart = (~s.alive) & ((restart_draw & ~roll_down) | roll_up)
+    crash_draw, w_crash = blk.bern_w(kn.p_crash, (n,))
+    crash_bern = s.alive & crash_draw
     # Keep a quorum-capable cluster: at most max_dead simultaneously-dead nodes.
     dead_after_restart = jnp.sum((~s.alive) & (~restart))
     budget = kn.max_dead - dead_after_restart
-    crash = crash_draw & (jnp.cumsum(crash_draw.astype(I32)) <= budget)
+    # scheduled kills BYPASS the budget: a game-day drill does not respect
+    # the fault budget (that is the point of the drill)
+    crash = (crash_bern & (jnp.cumsum(crash_bern.astype(I32)) <= budget)) \
+        | (s.alive & roll_down)
     alive = (s.alive | restart) & ~crash
 
     # Restart = recovery from persisted state (term/voted_for/log/base survive;
@@ -270,13 +329,31 @@ def step_cluster(
         # in a term it already voted in (two leaders share the term; the
         # election-safety oracle must fire). config.py RAFT_BUGS.
         s = s._replace(voted_for=jnp.where(restart, -1, s.voted_for))
-    timer = jnp.where(restart, _timeout_draw(kn, blk, (n,)), s.timer)
+    rst_tmr, w_rst_tmr = blk.randint_w(kn.eto_min, kn.eto_max + 1, (n,))
+    timer = jnp.where(restart, rst_tmr + skew, s.timer)
     hb = jnp.where(restart, 0, s.hb)
     commit = jnp.where(restart, s.base, s.commit)
     compact_floor = jnp.where(restart, s.base, s.compact_floor)
     votes = jnp.where(restart[:, None], False, s.votes)
     next_idx = jnp.where(restart[:, None], 1, s.next_idx)
     match_idx = jnp.where(restart[:, None], 0, s.match_idx)
+
+    # Limping nodes (ISSUE 19): an alive node enters a limp with p_limp,
+    # multiplying ALL its send delays by a factor drawn in
+    # [2, limp_mult_max] (redrawn per episode); it heals with p_limp_heal,
+    # and a restart always clears it (fresh process). Every draw rides the
+    # FREE low bytes of words consumed above (crash draw -> onset,
+    # restart draw -> multiplier, restart-timer draw -> heal): zero extra
+    # PRNG budget, bit-identical at the neutral knobs.
+    limp_on = alive & (kn.limp_mult_max >= 2) & _bern8(w_crash, kn.p_limp)
+    limp_mult = _randint8(w_restart, 2, kn.limp_mult_max - 1)
+    limp = jnp.where(
+        restart, 1,
+        jnp.where(
+            limp_on, limp_mult,
+            jnp.where(_bern8(w_rst_tmr, kn.p_limp_heal), 1, s.limp),
+        ),
+    )
 
     # Partition schedule, one mutually-exclusive event per tick drawn from a
     # single uniform: random symmetric 2-coloring (connect2/disconnect2
@@ -466,8 +543,10 @@ def step_cluster(
     voted_for = jnp.where(higher, -1, voted_for)
     acc = got & (mterm == term)
     role = jnp.where(acc & (role == CANDIDATE), FOLLOWER, role)
-    # current-leader contact resets the election timer
-    timer = jnp.where(acc, _timeout_draw(kn, blk, (n,)), timer)
+    # current-leader contact resets the election timer (low bytes of the
+    # draw words carry the gray fsync-stall ONSET — see the fsync phase)
+    snap_tmr, w_snap_tmr = blk.randint_w(kn.eto_min, kn.eto_max + 1, (n,))
+    timer = jnp.where(acc, snap_tmr + skew, timer)
     slen = picked(pick, jnp.broadcast_to(s.base[None, :], (n, n)))
     sterm_snap = picked(pick, jnp.broadcast_to(s.snap_term[None, :], (n, n)))
     # cond_install (raft.rs:153): ignore a snapshot behind our commit.
@@ -532,7 +611,10 @@ def step_cluster(
         (voted_for == -1) | (voted_for == src_id)
     ) & log_ok
     voted_for = jnp.where(grant, src_id, voted_for)
-    timer = jnp.where(grant, _timeout_draw(kn, blk, (n,)), timer)
+    # (low bytes of the grant-timer words carry the gray fsync-stall
+    # DURATION draw — see the fsync phase)
+    grant_tmr, w_grant_tmr = blk.randint_w(kn.eto_min, kn.eto_max + 1, (n,))
+    timer = jnp.where(grant, grant_tmr + skew, timer)
     if cfg.bug != "ack_before_fsync":
         # persist-before-reply (raft.rs:224-233): the response exposes
         # term and (via the grant) voted_for — fsync them first. Under the
@@ -542,6 +624,7 @@ def step_cluster(
         durable_voted_for = jnp.where(got, voted_for, durable_voted_for)
         durable_len = jnp.where(got, log_len, durable_len)
     delay, lost = _net_draws(kn, blk, (n,))
+    delay = delay * limp  # gray limp: the VOTER is the sender (ISSUE 19)
     send = got & ~lost  # per voter (one response per tick)
     # response slot [candidate, voter] <- the picked (voter, candidate) pair
     resp = pick.T & send[None, :]
@@ -578,7 +661,7 @@ def step_cluster(
     voted_for = jnp.where(higher, -1, voted_for)
     acc = got & (mterm == term)  # AppendEntries from the current-term leader
     role = jnp.where(acc & (role == CANDIDATE), FOLLOWER, role)
-    timer = jnp.where(acc, _timeout_draw(kn, blk, (n,)), timer)
+    timer = jnp.where(acc, _timeout_draw(kn, blk, (n,), skew), timer)
     prev = picked(pick, s.ae_req_prev)
     mprev_term = picked(pick, s.ae_req_prev_term)
     # prev at-or-below our snapshot boundary is committed => matches by
@@ -716,6 +799,7 @@ def step_cluster(
         durable_term = jnp.where(got, term, durable_term)
         durable_voted_for = jnp.where(got, voted_for, durable_voted_for)
     delay, lost = _net_draws(kn, blk, (n,))
+    delay = delay * limp  # gray limp: the FOLLOWER is the sender
     send = got & ~lost  # per follower (one response per tick)
     # KEEP-OLDEST for periodically-regenerated messages: an occupied slot
     # (an in-flight response, incl. deferred ones) keeps its message and the
@@ -769,7 +853,7 @@ def step_cluster(
     role = jnp.where(fired, CANDIDATE, role)
     voted_for = jnp.where(fired, me, voted_for)
     votes = jnp.where(fired[:, None], eye, votes)
-    timer = jnp.where(fired, _timeout_draw(kn, blk, (n,)), timer)
+    timer = jnp.where(fired, _timeout_draw(kn, blk, (n,), skew), timer)
     # start_election persists before any RequestVote leaves (raft.rs:248).
     # Kept under ack_before_fsync: the bug strips only the HANDLER replies.
     durable_term = jnp.where(fired, term, durable_term)
@@ -780,6 +864,7 @@ def step_cluster(
         log_len > base, _row_gather(log_term, _slot(log_len, cap), cap), snap_term
     )
     delay, lost = _net_draws(kn, blk, (n, n))
+    delay = delay * limp[None, :]  # gray limp: src is the column axis
     send_rv = fired[None, :] & ~eye & adj & ~lost  # [dst, src]; adj[dst, src]
     #                                               = link src->dst usable
     rv_req_t = jnp.where(send_rv, t + delay, rv_req_t)
@@ -824,6 +909,7 @@ def step_cluster(
         snap_term[None, :],
     )
     delay, lost = _net_draws(kn, blk, (n, n))
+    delay = delay * limp[None, :]  # gray limp: src is the column axis
     # Eager replication: a leader with unsent entries for a peer fires an AE
     # at once — the reference replicates on start() immediately
     # (raft.rs:266-293 fan-out); the heartbeat cadence governs only the idle
@@ -844,6 +930,7 @@ def step_cluster(
     ae_req_n = jnp.where(send_ae, n_m, s.ae_req_n)
     ae_req_commit = jnp.where(send_ae, commit[None, :], s.ae_req_commit)
     delay_sn, lost_sn = _net_draws(kn, blk, (n, n))
+    delay_sn = delay_sn * limp[None, :]  # gray limp: src is the column axis
     send_sn = (
         fire_hb[None, :] & ~eye & adj & ~lost_sn & need_snap & (sn_req_t == 0)
     )
@@ -1026,7 +1113,27 @@ def step_cluster(
     # historic perfect-persistence model (and the default). The traced-int
     # modulo is one [n] op per tick — noise next to the [n, cap] phases
     # (the _DrawBlock modulo cliff was per-draw at [n, n] scale).
-    do_fsync = alive & ((t + me) % kn.fsync_every == 0)
+    # Gray fsync stalls (ISSUE 19): a write spike delays the BACKGROUND
+    # cadence for a drawn duration — the durable watermark lags, widening
+    # the ack_before_fsync volatile window. The explicit persist-before-*
+    # syncs above are NOT stalled (they model blocking fsync calls that
+    # complete within the tick), so the correct algorithm stays oracle-
+    # safe under any stall schedule. Onset rides the free low byte of the
+    # snap-accept timer words, the duration that of the grant-timer words
+    # (zero extra PRNG budget); a restart clears the stall with the rest
+    # of the process state.
+    stall_on = (
+        alive & (kn.fsync_stall_ticks >= 1)
+        & _bern8(w_snap_tmr, kn.p_fsync_stall)
+    )
+    fsync_stall = jnp.where(
+        restart, 0,
+        jnp.where(
+            stall_on, _randint8(w_grant_tmr, 1, kn.fsync_stall_ticks),
+            jnp.maximum(s.fsync_stall - 1, 0),
+        ),
+    )
+    do_fsync = alive & ((t + me) % kn.fsync_every == 0) & (fsync_stall == 0)
     durable_len = jnp.where(do_fsync, log_len, durable_len)
     durable_term = jnp.where(do_fsync, term, durable_term)
     durable_voted_for = jnp.where(do_fsync, voted_for, durable_voted_for)
@@ -1049,6 +1156,7 @@ def step_cluster(
     return ClusterState(
         tick=t,
         term=term, voted_for=voted_for, role=role, timer=timer, hb=hb, alive=alive,
+        limp=limp, fsync_stall=fsync_stall,
         log_term=log_term, log_val=log_val, log_len=log_len,
         base=base, snap_term=snap_term, prefix_hash=prefix_hash,
         commit=commit, compact_floor=compact_floor,

@@ -1,0 +1,276 @@
+"""The gray-failure game-day plane (ISSUE 19): limping nodes, per-node
+election clock skew, fsync stalls, deterministic rolling restart waves,
+and the open-loop / Zipf clerk workload.
+
+Two invariants anchor the plane:
+
+- **Zero cost when off.** Every gray knob is a runtime ``Knobs`` field
+  whose draws ride FREE low bytes of words the step already consumes —
+  the per-tick threefry budget (``step._block_total``) is pinned
+  unchanged, and a run with gray magnitudes configured but probabilities
+  at zero is bit-identical to the plain program, field for field.
+- **Slow-but-alive, not broken.** Each gray axis degrades timing only:
+  the correct algorithm must stay violation-free under every profile
+  (the clean legs here and bench's per-profile gate table), while the
+  widened windows make the PLANTED bugs easier to catch (the
+  ``fsync_stall`` x ``ack_before_fsync`` catch row; PERF.md round 19
+  records the limp x ``forget_voted_for`` A/B no fail-stop profile
+  reaches).
+"""
+
+import contextlib
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madraft_tpu.tpusim import SimConfig, fuzz
+from madraft_tpu.tpusim.config import (
+    OPEN_QUEUE_SLOTS,
+    profile_gates,
+    storm_profiles,
+    zipf_map,
+)
+from madraft_tpu.tpusim.engine import make_chunked_fuzz_fn
+from madraft_tpu.tpusim.kv import KvConfig, kv_report, make_kv_fuzz_fn
+from madraft_tpu.tpusim.state import init_cluster, packed_layout_reason
+from madraft_tpu.tpusim.step import _block_total, step_cluster
+
+_PROFILES = storm_profiles()
+STORM = _PROFILES["storm"][0]
+
+# the kv-layer substrate the open-loop tests run on (the fuzz-verb shape:
+# raft client channel off, service clerks drive the log)
+KV_RAFT = SimConfig(p_client_cmd=0.0, compact_at_commit=False)
+
+
+def _trees_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(
+        x.shape == y.shape and bool((np.asarray(x) == np.asarray(y)).all())
+        for x, y in zip(la, lb)
+    )
+
+
+# ------------------------------------------------------------ zero cost off
+def test_per_tick_draw_budget_unchanged():
+    # The gray axes consume ZERO extra PRNG words: onset/multiplier/heal/
+    # stall draws ride free low bytes of existing words, skew and rolling
+    # waves are pure arithmetic. The budget formula is re-stated literally
+    # so any new blk.bern/randint call shows up as a pin diff here AND a
+    # draw-parity diff in test_lint.
+    for n in (3, 5, 7):
+        assert _block_total(n) == 11 * n + 3 + 3 * n * n
+
+
+def test_inert_gray_magnitudes_bit_identical():
+    # Magnitude knobs configured, probabilities/periods at zero: the
+    # trajectory must be bit-identical to the plain storm, every field —
+    # not merely report-equal. (eto_skew and rolling_period are the two
+    # knobs that act without a probability, so THEY stay 0 here.)
+    inert = STORM.replace(
+        p_limp=0.0, limp_mult_max=9, p_limp_heal=0.7,
+        p_fsync_stall=0.0, fsync_stall_ticks=31,
+        rolling_period=0, rolling_down=0, eto_skew=0,
+    )
+    base = make_chunked_fuzz_fn(STORM, 32, 120)(7)
+    gray = make_chunked_fuzz_fn(inert, 32, 120)(7)
+    assert _trees_equal(base, gray), (
+        "inert gray knobs perturbed the trajectory"
+    )
+
+
+def test_open_loop_cap_zero_is_closed_loop_bit_identical():
+    # open_queue_cap=0 IS the closed loop: a nonzero offered rate must be
+    # inert (the arrival gate is the cap, so the same words feed the same
+    # p_op Bernoulli) — final service states compare bit-for-bit.
+    kcfg = KvConfig(p_get=0.3, p_put=0.2)
+    shut = kcfg.replace(open_rate=0.9, open_queue_cap=0, zipf_a=1.0)
+    a = make_kv_fuzz_fn(KV_RAFT, kcfg, 16, 120)(3)
+    b = make_kv_fuzz_fn(KV_RAFT, shut, 16, 120)(3)
+    assert _trees_equal(a, b), "cap-0 open-loop knobs perturbed the clerks"
+
+
+# ----------------------------------------------------------------- gray axes
+def test_clock_skew_offsets_election_windows_at_init():
+    # Same key, same base draw: node i's initial election timer under skew
+    # differs from the unskewed init by EXACTLY i * eto_skew.
+    key = jax.random.PRNGKey(11)
+    skewed = STORM.replace(eto_skew=4)
+    t0 = np.asarray(init_cluster(STORM, key, STORM.knobs()).timer)
+    t1 = np.asarray(init_cluster(skewed, key, skewed.knobs()).timer)
+    assert (t1 - t0 == 4 * np.arange(STORM.n_nodes)).all()
+
+
+def test_limp_state_bounded_and_episodes_occur():
+    cfg = _PROFILES["limp"][0]
+    final = make_chunked_fuzz_fn(cfg, 32, 120)(0)
+    limp = np.asarray(final.limp)
+    assert limp.min() >= 1 and limp.max() <= cfg.limp_mult_max, (
+        f"limp multiplier out of [1, {cfg.limp_mult_max}]: "
+        f"[{limp.min()}, {limp.max()}]"
+    )
+    assert (limp > 1).any(), "no limp episode in 32x120 — axis inert?"
+    assert np.asarray(final.violations).sum() == 0
+
+
+def test_fsync_stall_clean_and_watermark_legal():
+    # The widest ack_before_fsync window any profile offers must still be
+    # provably safe for the CORRECT algorithm (handler persist-before-
+    # reply is a blocking fsync, never stalled), with the stall counter
+    # bounded and the watermark ordering intact at every tick.
+    cfg = _PROFILES["fsync_stall"][0]
+    key = jax.random.fold_in(jax.random.PRNGKey(2), 0)
+    kn = cfg.knobs()
+
+    @jax.jit
+    def run(key):
+        def body(carry, _):
+            nxt = step_cluster(cfg, carry, key, kn)
+            return nxt, (nxt.fsync_stall, nxt.durable_len, nxt.log_len,
+                         nxt.base)
+        return jax.lax.scan(
+            body, init_cluster(cfg, key, kn), None, length=400
+        )[1]
+
+    stall, dlen, llen, base = [np.asarray(x) for x in run(key)]
+    assert stall.min() >= 0 and stall.max() <= cfg.fsync_stall_ticks
+    assert stall.max() > 0, "no stall episode in 400 ticks — axis inert?"
+    assert (dlen <= llen).all() and (base <= dlen).all()
+    rep = fuzz(cfg, seed=0, n_clusters=64, n_ticks=300)
+    assert rep.n_violating == 0, "correct algorithm unsafe under stalls"
+
+
+def test_fsync_stall_widens_the_planted_bug_window():
+    # The catch row: the stall profile must surface ack_before_fsync at a
+    # budget where it demonstrably fires (bench A/B: it catches MORE lanes
+    # than the plain durability storm and a log-matching fingerprint the
+    # fail-stop profiles never reach — PERF.md round 19).
+    cfg = _PROFILES["fsync_stall"][0].replace(bug="ack_before_fsync")
+    rep = fuzz(cfg, seed=12345, n_clusters=64, n_ticks=300)
+    assert rep.n_violating >= 1, "stall profile missed the planted bug"
+
+
+def test_rolling_wave_schedule_is_deterministic():
+    # No Bernoulli faults at all: wave w takes node (w mod n) down for
+    # exactly the first rolling_down ticks of [w*P, (w+1)*P). The alive
+    # trajectory must match the schedule EXACTLY, tick for tick, never
+    # lose two nodes at once, and be identical across seeds (the schedule
+    # consumes no randomness).
+    P, D = 16, 5
+    cfg = _PROFILES["rolling_wave"][0].replace(
+        rolling_period=P, rolling_down=D, loss_prob=0.0,
+    )
+    n = cfg.n_nodes
+    kn = cfg.knobs()
+
+    def alive_track(seed):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
+
+        @jax.jit
+        def run(key):
+            def body(carry, _):
+                nxt = step_cluster(cfg, carry, key, kn)
+                return nxt, (nxt.tick, nxt.alive, nxt.commit)
+            return jax.lax.scan(
+                body, init_cluster(cfg, key, kn), None, length=4 * P
+            )[1]
+
+        return [np.asarray(x) for x in run(key)]
+
+    tick, alive, commit = alive_track(0)
+    me = np.arange(n)
+    for tt, row in zip(tick, alive):
+        wave_i = tt // P - ((tt // P - me) % n)
+        down = (wave_i >= 0) & (tt - wave_i * P < D)
+        assert (row == ~down).all(), f"tick {tt}: alive {row} != {~down}"
+        assert (~row).sum() <= 1
+    _, alive2, _ = alive_track(1)
+    assert (alive == alive2).all(), "rolling schedule drank randomness"
+    assert commit[-1].max() > commit[0].max(), "no commit progress via waves"
+
+
+# -------------------------------------------------------- open-loop workload
+def test_open_loop_queue_accounting():
+    # Saturating offered load: pending = arrivals - served stays within
+    # the cap at all times (checked at the horizon), overflow arrivals are
+    # counted as drops, and the clerks actually serve (the queue is a
+    # queue, not a bit bucket).
+    kcfg = KvConfig(p_get=0.3, p_put=0.2, open_rate=0.6, open_queue_cap=4)
+    final = make_kv_fuzz_fn(KV_RAFT, kcfg, 16, 200)(1)
+    arr = np.asarray(final.open_arr)
+    srv = np.asarray(final.open_srv)
+    drop = np.asarray(final.open_drop)
+    assert (arr >= srv).all() and (arr - srv <= 4).all()
+    assert srv.sum() > 0, "open-loop clerks never served an arrival"
+    assert drop.sum() > 0, "rate 0.6 at cap 4 never overflowed in 200 ticks"
+    assert kv_report(final).acked_ops.sum() > 0
+
+
+def test_open_loop_arrivals_feed_latency_plane():
+    # Arrival stamps (not dequeue ticks) are the submit times: the PR-10
+    # histogram must accumulate mass under open-loop traffic, so queue
+    # wait is measured, not hidden.
+    kcfg = KvConfig(p_get=0.3, p_put=0.2, open_rate=0.4, open_queue_cap=8)
+    final = make_kv_fuzz_fn(KV_RAFT.replace(metrics=True), kcfg, 16, 200)(1)
+    rep = kv_report(final)
+    assert rep.lat_hist is not None and rep.lat_hist.sum() > 0
+    assert rep.violations.sum() == 0
+
+
+def test_zipf_map_identity_and_skew():
+    draws = jnp.arange(256, dtype=jnp.int32) % 64
+    ident = zipf_map(draws, 64, jnp.float32(1.0))
+    assert (np.asarray(ident) == np.asarray(draws)).all(), (
+        "zipf_a=1.0 must be the exact identity"
+    )
+    hot = np.asarray(zipf_map(draws, 64, jnp.float32(3.0)))
+    assert hot.min() >= 0 and hot.max() <= 63
+    assert hot.mean() < np.asarray(draws).mean() / 2, "a=3 barely skewed"
+    assert (hot == 0).mean() > (np.asarray(draws) == 0).mean(), (
+        "no hot-key concentration at key 0"
+    )
+
+
+# ------------------------------------------------------- registry and gates
+def test_every_profile_has_a_gate_and_packs_exact():
+    profs = storm_profiles()
+    gates = profile_gates()
+    assert set(gates) == set(profs), "gate table and registry diverged"
+    for name, (cfg, _, n_ticks, _) in profs.items():
+        g = gates[name]
+        assert g["liveness_floor"] > 0 and g["p99_ceiling"] > 0
+        assert len(g["bench_scale"]) == 2
+        assert g["bridge"] in ("mirrored", "unsupported")
+        # every named profile stays on the packed carry (the gray bounds
+        # gates in state.packed_layout_reason hold at its registry scale)
+        assert packed_layout_reason(cfg, cfg.knobs(), n_ticks) is None, (
+            f"profile {name!r} fell off the packed layout"
+        )
+    for name, g in gates.items():
+        for knob in g["workload"]:
+            assert knob in ("open_rate", "open_queue_cap", "zipf_a"), (
+                f"gate {name!r} carries a non-workload override {knob!r}"
+            )
+    assert 0 < OPEN_QUEUE_SLOTS <= 255
+
+
+def test_cli_list_profiles_and_unknown_profile():
+    from madraft_tpu.__main__ import main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["pool", "--list-profiles"])
+    assert rc == 0
+    out = buf.getvalue()
+    for name in storm_profiles():
+        assert name in out, f"--list-profiles omitted {name!r}"
+
+    err = io.StringIO()
+    with contextlib.redirect_stderr(err):
+        rc = main(["fuzz", "--profile", "nosuch"])
+    assert rc == 2, "unknown --profile must exit 2 (usage error)"
+    assert "nosuch" in err.getvalue() and "limp" in err.getvalue()

@@ -73,6 +73,10 @@ def _rand_state(cfg: SimConfig, rng: np.random.Generator,
         timer=ints(np.iinfo(np.uint16).max, (n,)),
         hb=ints(np.iinfo(np.uint16).max, (n,)),
         alive=bools((n,)),
+        # gray-failure state (ISSUE 19): limp is 1..255 (u8, never 0 on a
+        # live node), fsync_stall a u16 countdown
+        limp=ints(np.iinfo(np.uint8).max, (n,), lo=1),
+        fsync_stall=ints(np.iinfo(np.uint16).max, (n,)),
         log_term=ints(b.term, (n, cap)),
         log_val=cmds((n, cap)),
         log_len=ints(b.index, (n,)),
@@ -318,6 +322,24 @@ def test_wide_fallback_reasons_and_forced_pack_rejection():
     zero_delay = STORM.replace(delay_min=0)
     assert "delay_min" in st.packed_layout_reason(
         zero_delay, zero_delay.knobs(), 10)
+    # ISSUE 19 gray-failure gates: the limp multiplier and the stretched
+    # delay must fit the u8 fields, a stall spike its u16 field, and the
+    # per-node skew offset the u16 timer — all exact-or-wide, never wrap
+    limp_wide = STORM.replace(p_limp=0.1, limp_mult_max=300)
+    assert "limp_mult_max" in st.packed_layout_reason(
+        limp_wide, limp_wide.knobs(), 10)
+    limp_stretch = STORM.replace(p_limp=0.1, limp_mult_max=100, delay_max=5)
+    assert "stretched delay" in st.packed_layout_reason(
+        limp_stretch, limp_stretch.knobs(), 10)
+    stall_wide = STORM.replace(p_fsync_stall=0.1, fsync_stall_ticks=70000)
+    assert "fsync_stall_ticks" in st.packed_layout_reason(
+        stall_wide, stall_wide.knobs(), 10)
+    skew_wide = STORM.replace(eto_skew=20000)
+    assert "eto_skew" in st.packed_layout_reason(
+        skew_wide, skew_wide.knobs(), 10)
+    # neutral gray knobs never trip a gate (limp_mult_max=1 means the
+    # stretch is the identity even with a wide delay budget)
+    assert st.packed_layout_reason(STORM, STORM.knobs(), 10) is None
     # auto mode falls back (and says so); forcing the pack refuses loudly
     s = run_pool(wide_delay, 3, 8, 32, chunk_ticks=32, budget_ticks=32)
     assert s["state_layout"] == "wide"

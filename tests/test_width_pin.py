@@ -150,7 +150,9 @@ KV_SPEC_PIN = dict(RAFT_SPEC_PIN, cmd="uint32")
 KV_DTS_PIN = {
     "clerk_seq": "uint16", "clerk_out": "bool", "clerk_key": "uint8",
     "clerk_kind": "uint8", "clerk_acked": "uint16", "clerk_leader": "int8",
-    "clerk_wait": "uint16", "clerk_sub": "uint16", "clerk_app": "uint16",
+    "clerk_wait": "uint16", "open_arr": "uint16", "open_srv": "uint16",
+    "open_drop": "uint16", "open_stamp": "uint16",
+    "clerk_sub": "uint16", "clerk_app": "uint16",
     "clerk_cmt": "uint16", "clerk_apl": "uint16", "client_retries": "uint16",
     "key_lat_hist": "uint16", "client_lat_hist": "uint16",
     "truth_count": "uint16", "truth_max_seq": "uint16",
@@ -196,7 +198,9 @@ SHARDKV_DTS_PIN = {
     "clerk_out": "bool", "clerk_shard": "uint8", "clerk_kind": "uint8",
     "clerk_cfg": "uint8", "clerk_wrong": "bool", "clerk_acked": "uint16",
     "clerk_get_lo": "uint16", "clerk_get_obs": "int16",
-    "gets_done": "uint16", "clerk_sub": "uint16", "lat_hist": "uint16",
+    "gets_done": "uint16", "open_arr": "uint16", "open_srv": "uint16",
+    "open_drop": "uint16", "open_stamp": "uint16",
+    "clerk_sub": "uint16", "lat_hist": "uint16",
     "clerk_app": "uint16", "clerk_cmt": "uint16", "clerk_apl": "uint16",
     "clerk_mig": "uint16", "client_retries": "uint16",
     "phase_hist": "uint16", "phase_ticks": "int32", "lat_ticks": "int32",
@@ -290,7 +294,9 @@ def test_static_bytes_per_lane_pool_shape():
     cfg = DURABILITY.replace(bug="ack_before_fsync")
     got = abstract_bytes(jax.eval_shape(
         lambda k: pack_state(cfg, init_cluster(cfg, k)), _KEY))
-    assert got == 2597, f"packed raft carry drifted: {got} B/lane != 2597"
+    # 2597 -> 2612 in round 19: +15 B for the gray per-node state
+    # (limp u8 x5 + fsync_stall u16 x5)
+    assert got == 2612, f"packed raft carry drifted: {got} B/lane != 2612"
     assert got <= 2800  # the retired ci.sh BYTES_PER_LANE_BOUND
 
 
@@ -298,7 +304,9 @@ def test_static_bytes_per_lane_metrics_shape():
     cfg = DURABILITY.replace(bug="ack_before_fsync", metrics=True)
     got = abstract_bytes(jax.eval_shape(
         lambda k: pack_state(cfg, init_cluster(cfg, k)), _KEY))
-    assert got == 3585, f"metrics-on packed carry drifted: {got} != 3585"
+    # 3585 -> 3600 in round 19 (gray per-node state, as above) — exactly
+    # AT the retired ceiling; the next widened field must argue its case
+    assert got == 3600, f"metrics-on packed carry drifted: {got} != 3600"
     assert got <= 3600  # the retired METRICS_BYTES_PER_LANE_BOUND
 
 
@@ -308,7 +316,9 @@ def test_static_bytes_per_deployment_shardkv_shape():
         lambda k: pack_shardkv_state(
             SHARDKV_CFG, kcfg,
             init_shardkv_cluster(SHARDKV_CFG, kcfg, k)), _KEY))
-    assert got == 12840, f"packed shardkv carry drifted: {got} != 12840"
+    # 12840 -> 12894 in round 19: gray raft state x2 carries (group +
+    # ctrl) + the open-loop clerk queue cursors/stamp ring
+    assert got == 12894, f"packed shardkv carry drifted: {got} != 12894"
     assert got <= 14000  # the retired SHARDKV_BYTES_PER_DEPLOYMENT_BOUND
 
 
@@ -319,10 +329,13 @@ def test_static_bytes_service_lanes():
     got = abstract_bytes(jax.eval_shape(
         lambda k: pack_kv_state(KV_CFG, kcfg,
                                 init_kv_cluster(KV_CFG, kcfg, k)), _KEY))
-    assert got == 3863, f"packed kv carry drifted: {got} != 3863"
+    # 3863 -> 3902 in round 19 (gray raft state + open-loop clerk queue)
+    assert got == 3902, f"packed kv carry drifted: {got} != 3902"
     ccfg = CtrlerConfig()
     got = abstract_bytes(jax.eval_shape(
         lambda k: pack_ctrler_state(
             CTRLER_CFG, ccfg,
             init_ctrler_cluster(CTRLER_CFG, ccfg, k)), _KEY))
-    assert got == 3622, f"packed ctrler carry drifted: {got} != 3622"
+    # 3622 -> 3637 in round 19 (gray raft per-node state; the ctrler
+    # clerk stays closed-loop, so no open-loop fields here)
+    assert got == 3637, f"packed ctrler carry drifted: {got} != 3637"
